@@ -1,0 +1,8 @@
+# Test runs force the virtual CPU mesh and bypass the TPU-tunnel bootstrap
+# (PALLAS_AXON_POOL_IPS= disables the sitecustomize PJRT registration, which
+# otherwise stalls every interpreter start for minutes in this environment).
+test:
+	PALLAS_AXON_POOL_IPS= python -m pytest tests/ -x -q
+
+bench:
+	python bench.py

@@ -1,0 +1,35 @@
+"""citizensassemblies_tpu — a TPU-native framework for fair citizens'-assembly selection.
+
+A ground-up JAX/XLA re-design of the capabilities of the
+``sirandreww/citizensassemblies-replication`` package (Flanigan, Gölz, Gupta,
+Hennig, Procaccia — "Fair Algorithms for Selecting Citizens' Assemblies", 2021):
+
+* **LEGACY** — the Sortition Foundation's greedy stratified sampler, re-expressed
+  as a jittable ``lax.scan`` over dense count tensors and ``vmap``-ed over
+  thousands of Monte-Carlo chains (reference: ``legacy.py``).
+* **LEXIMIN** — the exact lexicographic-maximin distribution over feasible
+  panels, via column generation with on-device LP solves (PDHG) and a massively
+  parallel stochastic pricing oracle, certified by an exact MILP oracle
+  (reference: ``leximin.py``).
+* **XMIN** — LEXIMIN's probabilities re-spread over a maximally large support
+  of panels via a min-L2 final stage (reference: ``xmin.py``).
+* A full analysis/reporting layer (statistics, plots, golden-format outputs)
+  mirroring the reference's ``analysis.py``.
+
+Core representational shift: instead of dict-of-dicts over string keys, the
+framework works on the dense incidence matrix ``A ∈ {0,1}^{n×F}`` (agent ×
+feature-value), quota vectors ``q_min, q_max ∈ Z^F`` and panel size ``k``.
+A panel is a binary vector ``x`` with ``A.T @ x ∈ [q_min, q_max]`` and
+``sum(x) = k``; a portfolio is a matrix ``P ∈ {0,1}^{|C|×n}``; a probability
+allocation is ``π = P.T @ p`` — all one-line jittable reductions.
+"""
+
+__version__ = "0.1.0"
+
+from citizensassemblies_tpu.core.instance import (  # noqa: F401
+    DenseInstance,
+    FeatureSpace,
+    Instance,
+    featurize,
+    read_instance,
+)

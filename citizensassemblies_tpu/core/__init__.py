@@ -1,0 +1,8 @@
+from citizensassemblies_tpu.core.instance import (  # noqa: F401
+    DenseInstance,
+    FeatureSpace,
+    Instance,
+    featurize,
+    read_instance,
+    validate_quotas,
+)

@@ -1,0 +1,170 @@
+"""Synthetic instance generation.
+
+Covers the reference's cross-product generator (``data/generate_examples/main.py``:
+hard-coded category/feature/quota lists, respondents as the cross product of all
+feature combinations with per-combination counts) and adds parameterized random
+instance families used for benchmarking at reference scale (e.g. an
+``sf_e_110``-like pool: n=1727, k=110, 7 categories — the real pool is withheld
+for privacy, reference ``README.md:125-132``, so benchmarks run on synthetic
+pools with matching shape statistics).
+"""
+
+from __future__ import annotations
+
+import csv
+import itertools
+import math
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple, Union
+
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import Instance, Quota
+
+
+def cross_product_instance(
+    categories: Sequence[str],
+    features: Sequence[Sequence[str]],
+    quotas: Sequence[Sequence[Tuple[int, int]]],
+    counts: Sequence[int],
+    k: int,
+    name: str = "synthetic",
+) -> Instance:
+    """Build an instance whose pool enumerates the cross product of all feature
+    combinations, repeating combination ``i`` ``counts[i]`` times — the
+    reference generator's respondent layout (``data/generate_examples/main.py``).
+    """
+    combos = list(itertools.product(*features))
+    if len(counts) != len(combos):
+        raise ValueError(f"need {len(combos)} counts, got {len(counts)}")
+    cat_quotas: Dict[str, Dict[str, Quota]] = {}
+    for ci, cat in enumerate(categories):
+        cat_quotas[cat] = {feat: tuple(quotas[ci][fi]) for fi, feat in enumerate(features[ci])}
+    agents: List[Dict[str, str]] = []
+    for combo, count in zip(combos, counts):
+        for _ in range(count):
+            agents.append({cat: feat for cat, feat in zip(categories, combo)})
+    return Instance(k=k, categories=cat_quotas, agents=agents, name=name)
+
+
+def random_instance(
+    n: int,
+    k: int,
+    n_categories: int,
+    features_per_category: Union[int, Sequence[int]] = 3,
+    seed: int = 0,
+    quota_slack: float = 0.35,
+    concentration: float = 2.0,
+    name: str = "",
+) -> Instance:
+    """Generate a random feasible instance with realistic quota structure.
+
+    Feature shares per category are drawn from a Dirichlet(``concentration``);
+    each agent samples one feature per category independently. Quotas bracket
+    the proportional panel composition: for pool share ``s`` the quota is
+    ``[floor((1-slack)*s*k), ceil((1+slack)*s*k)]``, then adjusted so each
+    category's lower quotas sum to ≤ k and upper quotas to ≥ k (the sanity
+    conditions the reference asserts at ``analysis.py:174-176``). Proportional
+    quotas around observed pool shares guarantee the pool itself scales down to
+    a feasible panel, so the instance is feasible by construction.
+    """
+    rng = np.random.default_rng(seed)
+    if isinstance(features_per_category, int):
+        features_per_category = [features_per_category] * n_categories
+
+    categories: Dict[str, Dict[str, Quota]] = {}
+    assignments: List[np.ndarray] = []
+    for ci in range(n_categories):
+        m = features_per_category[ci]
+        shares = rng.dirichlet([concentration] * m)
+        # ensure every feature actually appears in the pool
+        labels = rng.choice(m, size=n, p=shares)
+        for f in range(m):
+            if not np.any(labels == f):
+                labels[rng.integers(n)] = f
+        assignments.append(labels)
+        counts = np.bincount(labels, minlength=m)
+        pool_shares = counts / n
+        quotas: Dict[str, Quota] = {}
+        for f in range(m):
+            lo = int(math.floor((1 - quota_slack) * pool_shares[f] * k))
+            hi = int(math.ceil((1 + quota_slack) * pool_shares[f] * k))
+            hi = max(hi, lo + 1, 1)
+            quotas[f"c{ci}f{f}"] = (lo, hi)
+        # repair category-level sanity: sum(lo) <= k <= sum(hi)
+        los = [quotas[f"c{ci}f{f}"][0] for f in range(m)]
+        his = [quotas[f"c{ci}f{f}"][1] for f in range(m)]
+        f = 0
+        while sum(los) > k:
+            if los[f % m] > 0:
+                los[f % m] -= 1
+            f += 1
+        f = 0
+        while sum(his) < k:
+            his[f % m] += 1
+            f += 1
+        for ff in range(m):
+            quotas[f"c{ci}f{ff}"] = (los[ff], his[ff])
+        categories[f"cat{ci}"] = quotas
+
+    agents = [
+        {f"cat{ci}": f"c{ci}f{assignments[ci][i]}" for ci in range(n_categories)}
+        for i in range(n)
+    ]
+    return Instance(
+        k=k, categories=categories, agents=agents, name=name or f"random_{n}_{k}_{seed}"
+    )
+
+
+def sf_e_like_instance(seed: int = 0) -> Instance:
+    """Synthetic stand-in for the withheld ``sf_e_110`` pool: n=1727, k=110,
+    7 quota categories (shape from ``reference_output/sf_e_110_statistics.txt:2-5``)."""
+    return random_instance(
+        n=1727,
+        k=110,
+        n_categories=7,
+        features_per_category=[2, 4, 5, 3, 2, 4, 6],
+        seed=seed,
+        quota_slack=0.3,
+        name="sf_e_like_110",
+    )
+
+
+def example_small_like_instance(seed: int = 0) -> Instance:
+    """Synthetic stand-in shaped like ``example_small_20``: n=200, k=20, two
+    binary categories with quotas [9, 20] (see
+    ``data/example_small_20/categories.csv``)."""
+    rng = np.random.default_rng(seed)
+    categories = {
+        "gender": {"female": (9, 20), "male": (9, 20)},
+        "leaning": {"liberal": (9, 20), "conservative": (9, 20)},
+    }
+    agents = [
+        {
+            "gender": "female" if rng.random() < 0.5 else "male",
+            "leaning": "liberal" if rng.random() < 0.65 else "conservative",
+        }
+        for _ in range(200)
+    ]
+    return Instance(k=20, categories=categories, agents=agents, name="example_small_like_20")
+
+
+def write_instance_csvs(instance: Instance, directory: Union[str, Path]) -> None:
+    """Write ``categories.csv`` + ``respondents.csv`` in the reference input
+    schema (``README.md`` data format; note the reference generator writes
+    typo'd ``categories.cvs``/``respondentes.cvs`` — we emit the names the CLI
+    actually consumes, ``analysis.py:660-666``)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    with open(directory / "categories.csv", "w", newline="", encoding="utf-8") as fh:
+        writer = csv.writer(fh)
+        writer.writerow(["category", "feature", "min", "max"])
+        for cat, feats in instance.categories.items():
+            for feat, (lo, hi) in feats.items():
+                writer.writerow([cat, feat, lo, hi])
+    cat_names = list(instance.categories)
+    with open(directory / "respondents.csv", "w", newline="", encoding="utf-8") as fh:
+        writer = csv.writer(fh)
+        writer.writerow(cat_names)
+        for agent in instance.agents:
+            writer.writerow([agent[c] for c in cat_names])

@@ -1,0 +1,255 @@
+"""Problem instances: CSV input schema and the dense TPU representation.
+
+Host-side, an :class:`Instance` mirrors the reference's problem container
+(``analysis.py:54-58``: panel size ``k``, per-category per-feature quotas, and
+one categorical feature per category per agent), read from the two-CSV schema
+documented in the reference README (``categories.csv`` with columns
+``category,feature,min,max``; ``respondents.csv`` with one column per category —
+reference ``analysis.py:108-138``, agent ids are row indices).
+
+Device-side, :func:`featurize` lowers an instance to a :class:`DenseInstance`:
+
+* ``A`` — the ``{0,1}^{n×F}`` agent×feature-value incidence matrix, where the
+  flat feature axis enumerates ``(category, feature)`` cells in file order
+  (category order of ``categories.csv``, feature order of first appearance) —
+  the same iteration order as the reference's nested dicts, which matters for
+  LEGACY's first-max tie-breaking (``legacy.py:124-157``).
+* ``qmin``/``qmax`` — per-cell quota vectors.
+* ``cat_of_feature`` — flat-cell → category index (each agent has exactly one
+  cell per category: ``A @ cat_onehot`` rows sum to 1 per category).
+
+Everything downstream (samplers, LPs, statistics) operates on these arrays.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+Quota = Tuple[int, int]  # (min, max)
+
+
+@dataclasses.dataclass
+class Instance:
+    """Host-side problem container (reference ``analysis.py:54-58``).
+
+    ``categories`` maps category name -> feature name -> (min, max) quota, in
+    file order. ``agents`` is a list indexed by agent id (row index in
+    ``respondents.csv``, reference ``analysis.py:131-132``), each a mapping
+    category -> feature. ``columns_data`` optionally carries extra per-agent
+    columns (e.g. address fields for household constraints,
+    ``legacy.py:78-99``).
+    """
+
+    k: int
+    categories: Dict[str, Dict[str, Quota]]
+    agents: List[Dict[str, str]]
+    name: str = ""
+    columns_data: Optional[List[Dict[str, str]]] = None
+
+    @property
+    def n(self) -> int:
+        return len(self.agents)
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureSpace:
+    """Static metadata naming the flat feature axis of a :class:`DenseInstance`."""
+
+    categories: Tuple[str, ...]  # category names, file order
+    cells: Tuple[Tuple[str, str], ...]  # flat index -> (category, feature)
+
+    @property
+    def n_features(self) -> int:
+        return len(self.cells)
+
+    @property
+    def n_categories(self) -> int:
+        return len(self.categories)
+
+    def feature_index(self, category: str, feature: str) -> int:
+        return self.cells.index((category, feature))
+
+    def cells_of_category(self, category: str) -> List[int]:
+        return [i for i, (c, _) in enumerate(self.cells) if c == category]
+
+
+class InfeasibleQuotasError(Exception):
+    """Raised when no panel can satisfy the quotas; carries a suggested minimal
+    relaxation (reference ``leximin.py:81-87``)."""
+
+    def __init__(self, quotas: Dict[Tuple[str, str], Quota], output: List[str]):
+        self.quotas = quotas
+        self.output = ["The quotas are infeasible:"] + output
+        super().__init__("\n".join(self.output))
+
+    def __str__(self) -> str:
+        return "\n".join(self.output)
+
+
+class SelectionError(Exception):
+    """Raised when panel selection fails (reference ``legacy.py:34-36``)."""
+
+    def __init__(self, message: str):
+        self.msg = message
+        super().__init__(message)
+
+
+@struct.dataclass
+class DenseInstance:
+    """Device-side dense instance pytree.
+
+    Attributes:
+      A: bool[n, F] incidence matrix (agent has feature-cell f).
+      qmin: int32[F] lower quotas.
+      qmax: int32[F] upper quotas.
+      cat_of_feature: int32[F] category index per flat cell.
+      k: static panel size.
+      n_categories: static number of categories.
+    """
+
+    A: jnp.ndarray
+    qmin: jnp.ndarray
+    qmax: jnp.ndarray
+    cat_of_feature: jnp.ndarray
+    k: int = struct.field(pytree_node=False)
+    n_categories: int = struct.field(pytree_node=False)
+
+    @property
+    def n(self) -> int:
+        return self.A.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.A.shape[1]
+
+
+def read_instance(
+    feature_file: Union[str, Path],
+    pool_file: Union[str, Path],
+    k: int,
+    name: str = "",
+    extra_columns: Sequence[str] = (),
+) -> Instance:
+    """Read an instance from the two-CSV schema (reference ``analysis.py:108-138``).
+
+    Unlike the reference, unknown feature values in the pool raise a clean
+    error instead of a ``KeyError``, and extra per-agent columns (for household
+    checks) can be retained via ``extra_columns``.
+    """
+    categories: Dict[str, Dict[str, Quota]] = {}
+    with open(feature_file, "r", encoding="utf-8") as fh:
+        for line in csv.DictReader(fh):
+            cat, feat = line["category"], line["feature"]
+            categories.setdefault(cat, {})
+            categories[cat][feat] = (int(line["min"]), int(line["max"]))
+
+    cat_names = list(categories)
+    agents: List[Dict[str, str]] = []
+    columns_data: List[Dict[str, str]] = []
+    with open(pool_file, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(csv.DictReader(fh)):
+            agent = {}
+            for cat in cat_names:
+                feat = line.get(cat)
+                if feat is None:
+                    raise ValueError(f"respondent row {i} is missing category column {cat!r}")
+                if feat not in categories[cat]:
+                    raise ValueError(
+                        f"respondent row {i} has feature {feat!r} for category {cat!r} "
+                        f"which does not appear in the categories file"
+                    )
+                agent[cat] = feat
+            agents.append(agent)
+            if extra_columns:
+                columns_data.append({col: line.get(col, "") for col in extra_columns})
+
+    return Instance(
+        k=k,
+        categories=categories,
+        agents=agents,
+        name=name or Path(pool_file).parent.name,
+        columns_data=columns_data or None,
+    )
+
+
+def read_instance_dir(directory: Union[str, Path], k: Optional[int] = None) -> Instance:
+    """Read ``<name>_<k>/categories.csv`` + ``respondents.csv`` the way the
+    reference CLI resolves instances (``analysis.py:649-668,703-705``)."""
+    directory = Path(directory)
+    if k is None:
+        stem, _, k_str = directory.name.rpartition("_")
+        if not stem or not k_str.isdigit():
+            raise ValueError(
+                f"directory name {directory.name!r} does not end in underscore + panel size"
+            )
+        k = int(k_str)
+    return read_instance(
+        directory / "categories.csv", directory / "respondents.csv", k, name=directory.name
+    )
+
+
+def featurize(instance: Instance) -> Tuple[DenseInstance, FeatureSpace]:
+    """Lower a host instance to its dense device representation."""
+    cells: List[Tuple[str, str]] = []
+    qmin: List[int] = []
+    qmax: List[int] = []
+    cat_of_feature: List[int] = []
+    cell_index: Dict[Tuple[str, str], int] = {}
+    cat_names = list(instance.categories)
+    for ci, cat in enumerate(cat_names):
+        for feat, (lo, hi) in instance.categories[cat].items():
+            cell_index[(cat, feat)] = len(cells)
+            cells.append((cat, feat))
+            qmin.append(lo)
+            qmax.append(hi)
+            cat_of_feature.append(ci)
+
+    n, F = len(instance.agents), len(cells)
+    A = np.zeros((n, F), dtype=bool)
+    for i, agent in enumerate(instance.agents):
+        for cat in cat_names:
+            A[i, cell_index[(cat, agent[cat])]] = True
+
+    dense = DenseInstance(
+        A=jnp.asarray(A),
+        qmin=jnp.asarray(qmin, dtype=jnp.int32),
+        qmax=jnp.asarray(qmax, dtype=jnp.int32),
+        cat_of_feature=jnp.asarray(cat_of_feature, dtype=jnp.int32),
+        k=instance.k,
+        n_categories=len(cat_names),
+    )
+    space = FeatureSpace(categories=tuple(cat_names), cells=tuple(cells))
+    return dense, space
+
+
+def validate_quotas(instance: Instance) -> None:
+    """Per-category sanity asserted by the reference before Monte-Carlo
+    estimation (``analysis.py:174-176``): the lower quotas of a category must
+    not exceed k in total, and the upper quotas must reach k."""
+    for cat, feats in instance.categories.items():
+        lo = sum(q[0] for q in feats.values())
+        hi = sum(q[1] for q in feats.values())
+        if lo > instance.k:
+            raise SelectionError(f"lower quotas of category {cat!r} sum to {lo} > k={instance.k}")
+        if hi < instance.k:
+            raise SelectionError(f"upper quotas of category {cat!r} sum to {hi} < k={instance.k}")
+
+
+def panels_to_matrix(panels: Sequence[Sequence[int]], n: int) -> np.ndarray:
+    """Stack agent-index panels into a binary portfolio matrix P ∈ {0,1}^{|C|×n}."""
+    P = np.zeros((len(panels), n), dtype=bool)
+    for row, panel in enumerate(panels):
+        P[row, list(panel)] = True
+    return P
+
+
+def matrix_to_panels(P: np.ndarray) -> List[Tuple[int, ...]]:
+    """Inverse of :func:`panels_to_matrix` (sorted agent ids per row)."""
+    return [tuple(np.nonzero(row)[0].tolist()) for row in np.asarray(P)]

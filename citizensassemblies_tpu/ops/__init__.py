@@ -1,0 +1,16 @@
+from citizensassemblies_tpu.ops.stats import (  # noqa: F401
+    ProbAllocationStats,
+    allocation_from_portfolio,
+    gini,
+    geometric_mean,
+    prob_allocation_stats,
+    share_below,
+    upper_confidence_bound,
+)
+from citizensassemblies_tpu.ops.pairs import (  # noqa: F401
+    pair_matrix_from_panels,
+    pair_matrix_from_portfolio,
+    sorted_pair_values,
+    uniform_pair_value,
+)
+from citizensassemblies_tpu.ops.ratio import compute_ratio_products  # noqa: F401

@@ -1,0 +1,79 @@
+"""Pairwise co-selection probabilities as dense symmetric matrices.
+
+The reference's ``PairHistogram`` (``analysis.py:68-98``) is a Python dict over
+all C(n,2) unordered pairs, updated in O(Σ k²) Python loops — the fork's key
+addition and a prime vectorization target (SURVEY.md §2 C4). Here the same
+object is the symmetric matrix ``M = Pᵀ diag(w) P`` with zeroed diagonal, built
+on the MXU in batched chunks: for one-hot panel rows ``S ∈ {0,1}^{B×n}`` and
+panel weights ``w``, ``M[i,j] = Σ_b w_b S[b,i] S[b,j]`` is exactly the pair
+co-selection mass of the portfolio (``analysis.py:90-95``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _one_hot_panels(panels: jnp.ndarray, n: int) -> jnp.ndarray:
+    """panels: int32[B, k] agent indices -> bool[B, n] membership rows."""
+    B = panels.shape[0]
+    S = jnp.zeros((B, n), dtype=jnp.float32)
+    return S.at[jnp.arange(B)[:, None], panels].set(1.0)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _pair_chunk(panels: jnp.ndarray, weights: jnp.ndarray, n: int) -> jnp.ndarray:
+    S = _one_hot_panels(panels, n)
+    M = (S * weights[:, None]).T @ S
+    return M * (1.0 - jnp.eye(n, dtype=M.dtype))
+
+
+def pair_matrix_from_panels(
+    panels, weights=None, *, n: int, chunk: int = 2048
+) -> jnp.ndarray:
+    """Accumulate the pair matrix over a (possibly huge) batch of panels.
+
+    ``panels`` is int[B, k]; ``weights`` defaults to 1 per panel (Monte-Carlo
+    counting; divide by the draw count afterwards as the reference does at
+    ``analysis.py:86-88``). Chunked so the one-hot buffer stays ≤ chunk×n.
+    """
+    panels = jnp.asarray(panels)
+    B = panels.shape[0]
+    if weights is None:
+        weights = jnp.ones((B,), dtype=jnp.float32)
+    else:
+        weights = jnp.asarray(weights, dtype=jnp.float32)
+    M = jnp.zeros((n, n), dtype=jnp.float32)
+    for start in range(0, B, chunk):
+        M = M + _pair_chunk(panels[start : start + chunk], weights[start : start + chunk], n)
+    return M
+
+
+def pair_matrix_from_portfolio(P, probs) -> jnp.ndarray:
+    """Pair matrix of a weighted portfolio: ``Pᵀ diag(p) P`` with zero diagonal
+    (the exact-distribution path, ``analysis.py:208,226``)."""
+    P = jnp.asarray(P, dtype=jnp.float32)
+    probs = jnp.asarray(probs, dtype=jnp.float32)
+    M = (P * probs[:, None]).T @ P
+    n = M.shape[0]
+    return M * (1.0 - jnp.eye(n, dtype=M.dtype))
+
+
+def sorted_pair_values(M) -> np.ndarray:
+    """All C(n,2) upper-triangle values sorted ascending — the series plotted
+    by the pair-probability curve (``analysis.py:339-347``)."""
+    M = np.asarray(M)
+    iu = np.triu_indices(M.shape[0], k=1)
+    vals = M[iu]
+    vals.sort()
+    return vals
+
+
+def uniform_pair_value(n: int) -> float:
+    """The uniform baseline 1/C(n,2) (``analysis.py:70-74``)."""
+    return 1.0 / (n * (n - 1) // 2)

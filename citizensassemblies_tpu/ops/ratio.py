@@ -1,0 +1,26 @@
+"""Ratio products: per-agent over-representation scores.
+
+Reference ``analysis.py:411-431``: for each (category, feature) cell, the
+representation ratio is ``pool_share / (quota_midpoint / k)``; an agent's ratio
+product is the product of her cells' ratios. On the dense representation this
+is one log-space matvec: ``exp(A @ log r)`` where ``r ∈ R^F`` is the per-cell
+ratio vector.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from citizensassemblies_tpu.core.instance import DenseInstance
+
+
+@jax.jit
+def compute_ratio_products(dense: DenseInstance) -> jnp.ndarray:
+    """float32[n] ratio products in agent order (``analysis.py:427-431``)."""
+    A = dense.A.astype(jnp.float32)
+    n = A.shape[0]
+    pool_share = jnp.sum(A, axis=0) / n
+    quota_midpoint = (dense.qmin + dense.qmax).astype(jnp.float32) / 2.0
+    cell_ratio = pool_share / (quota_midpoint / dense.k)
+    return jnp.exp(A @ jnp.log(cell_ratio))

@@ -1,0 +1,2 @@
+from citizensassemblies_tpu.utils.config import Config, default_config  # noqa: F401
+from citizensassemblies_tpu.utils.logging import RunLog  # noqa: F401

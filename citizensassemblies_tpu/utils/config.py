@@ -1,0 +1,88 @@
+"""Typed configuration for every knob the reference hard-codes.
+
+The reference scatters magic constants across modules (module-level ``EPS = 0.0005``
+at ``leximin.py:30``/``xmin.py:32``, 10,000 Monte-Carlo iterations at
+``analysis.py:288``, ``3 * n`` multiplicative-weight rounds at ``leximin.py:373``,
+0.8 weight decay at ``leximin.py:259``, 0.9/0.1 smoothing at ``leximin.py:273``,
+the 1e-4 fixed-probability relaxation step at ``leximin.py:412``, ``5 * n`` XMIN
+expansion iterations at ``xmin.py:511``, ``3 * n`` dedup attempts at
+``xmin.py:466``, Gurobi ``Method=2``/``Crossover=0`` at ``leximin.py:325-327``).
+Here they are all lifted into one frozen dataclass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # --- numerical tolerances -------------------------------------------------
+    #: numerical deviation accepted as equality when dealing with solvers
+    #: (reference ``leximin.py:30``).
+    eps: float = 5e-4
+    #: amount by which all fixed probabilities are shaved when the dual LP
+    #: becomes numerically infeasible (reference ``leximin.py:412``).
+    fixed_prob_relax_step: float = 1e-4
+    #: probabilities below this are treated as zero when counting the support
+    #: of a distribution (reference ``analysis.py:209``).
+    support_eps: float = 1e-11
+
+    # --- LEGACY Monte-Carlo ---------------------------------------------------
+    #: number of Monte-Carlo panel draws (reference ``analysis.py:288``).
+    mc_iterations: int = 10_000
+    #: chains drawn per device batch in the vectorized sampler.
+    mc_batch: int = 2_048
+    #: hard cap on resampling sweeps for rejected chains before giving up.
+    mc_max_resample_rounds: int = 200
+
+    # --- LEXIMIN column generation -------------------------------------------
+    #: multiplicative-weight portfolio-seeding rounds as a multiple of n
+    #: (reference ``leximin.py:373`` uses 3 * n sequential ILP solves; the TPU
+    #: path replaces them with batched stochastic sampling, this knob scales
+    #: the batch budget instead).
+    mw_rounds_factor: int = 3
+    #: weight decay applied to members of a freshly discovered committee
+    #: (reference ``leximin.py:259``).
+    mw_decay: float = 0.8
+    #: smoothing applied when a duplicate committee is produced
+    #: (reference ``leximin.py:273``): w <- mw_smooth * w + (1 - mw_smooth).
+    mw_smooth: float = 0.9
+    #: panels sampled per stochastic pricing batch on device.
+    pricing_batch: int = 4_096
+    #: maximum committees held in the padded portfolio buffer (static shape).
+    max_portfolio: int = 8_192
+
+    # --- XMIN -----------------------------------------------------------------
+    #: portfolio-expansion iterations as a multiple of n (reference ``xmin.py:511``).
+    xmin_iterations_factor: int = 5
+    #: attempts to sample a panel not already in the portfolio, as a multiple
+    #: of n (reference ``xmin.py:466``).
+    xmin_dedup_attempts_factor: int = 3
+
+    # --- PDHG LP solver -------------------------------------------------------
+    pdhg_max_iters: int = 100_000
+    pdhg_tol: float = 1e-7
+    pdhg_check_every: int = 64
+
+    # --- backends -------------------------------------------------------------
+    #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),
+    #: "highs" (host scipy/HiGHS LPs and MILPs — the cross-check backend), or
+    #: "hybrid" (TPU inner loops, host exact certification).
+    backend: str = "hybrid"
+    #: random seed used by solver-internal sampling (not MC estimation).
+    solver_seed: int = 0
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+_DEFAULT: Optional[Config] = None
+
+
+def default_config() -> Config:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = Config()
+    return _DEFAULT

@@ -1,0 +1,70 @@
+"""In-band log channel + tee logging.
+
+The reference's algorithms accumulate human-readable progress into
+``output_lines: List[str]`` (``leximin.py:54-56,429``) which the analysis layer
+returns alongside results, and ``analyze_instance`` tees console output into
+``analysis/<instance>_<k>_statistics.txt`` via a ``log()`` closure
+(``analysis.py:552-556``). ``RunLog`` preserves both behaviors behind one object.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from contextlib import contextmanager
+from pathlib import Path
+from typing import IO, List, Optional
+
+
+class RunLog:
+    """Collects algorithm output lines; optionally echoes to stdout and a file."""
+
+    def __init__(self, echo: bool = True, file: Optional[IO[str]] = None):
+        self.lines: List[str] = []
+        self.echo = echo
+        self.file = file
+        self._timers: dict[str, float] = {}
+
+    def emit(self, message: str) -> str:
+        """Record a line (the reference's ``_print`` at ``leximin.py:54-56``)."""
+        self.lines.append(message)
+        if self.echo:
+            print(message)
+        if self.file is not None:
+            self.file.write(message + "\n")
+        return message
+
+    def log(self, *info) -> None:
+        """Tab-joined tee write (the reference's ``log`` at ``analysis.py:554-556``)."""
+        msg = "\t".join(str(m) for m in info)
+        if self.echo:
+            print(*info)
+        if self.file is not None:
+            self.file.write(msg + "\n")
+        self.lines.append(msg)
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._timers[name] = self._timers.get(name, 0.0) + time.perf_counter() - t0
+
+    @property
+    def timers(self) -> dict:
+        return dict(self._timers)
+
+
+@contextmanager
+def tee_file(path: Path, echo: bool = True):
+    """Context manager yielding a RunLog that writes to ``path`` (utf-8)."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        yield RunLog(echo=echo, file=fh)
+
+
+def progress(i: int, total: int, every: int = 100, out: IO[str] = sys.stdout) -> None:
+    """Reference-style periodic progress print (``analysis.py:181-182``)."""
+    if (i + 1) % every == 0:
+        out.write(f"Running iteration {i + 1} out of {total}.\n")

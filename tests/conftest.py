@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Distributed code paths (shard_map Monte-Carlo, sharded LP matvecs) run in CI
+without TPU hardware on 8 virtual CPU devices, per the multi-chip test strategy
+in SURVEY.md §4.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# The session environment may pin JAX_PLATFORMS to a TPU tunnel (e.g. "axon");
+# tests must run on the virtual CPU mesh, so override unconditionally.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from pathlib import Path
+
+import pytest
+
+REFERENCE_DATA = Path("/root/reference/data")
+
+
+@pytest.fixture(scope="session")
+def reference_data_dir():
+    if not REFERENCE_DATA.is_dir():
+        pytest.skip("reference data not mounted")
+    return REFERENCE_DATA
+
+
+@pytest.fixture(scope="session")
+def example_small(reference_data_dir):
+    from citizensassemblies_tpu.core.instance import read_instance_dir
+
+    return read_instance_dir(reference_data_dir / "example_small_20")

@@ -1,0 +1,110 @@
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import (
+    cross_product_instance,
+    random_instance,
+    sf_e_like_instance,
+    write_instance_csvs,
+)
+from citizensassemblies_tpu.core.instance import (
+    SelectionError,
+    featurize,
+    matrix_to_panels,
+    panels_to_matrix,
+    read_instance_dir,
+    validate_quotas,
+)
+
+
+def test_read_example_small(example_small):
+    inst = example_small
+    assert inst.k == 20
+    assert inst.n == 200
+    assert list(inst.categories) == ["gender", "leaning"]
+    assert inst.categories["gender"]["female"] == (9, 20)
+    # agent ids are row indices; row 0 of respondents.csv is female/conservative
+    assert inst.agents[0] == {"gender": "female", "leaning": "conservative"}
+
+
+def test_featurize_example_small(example_small):
+    dense, space = featurize(example_small)
+    assert dense.A.shape == (200, 4)
+    assert space.cells == (
+        ("gender", "female"),
+        ("gender", "male"),
+        ("leaning", "liberal"),
+        ("leaning", "conservative"),
+    )
+    A = np.asarray(dense.A)
+    # exactly one feature per category per agent
+    assert (A[:, :2].sum(axis=1) == 1).all()
+    assert (A[:, 2:].sum(axis=1) == 1).all()
+    # feature counts match the pool
+    counts = A.sum(axis=0)
+    assert counts.sum() == 2 * 200
+    assert list(np.asarray(dense.qmin)) == [9, 9, 9, 9]
+    assert list(np.asarray(dense.qmax)) == [20, 20, 20, 20]
+    assert list(np.asarray(dense.cat_of_feature)) == [0, 0, 1, 1]
+
+
+def test_cross_product_instance_matches_reference_generator_shape():
+    # the reference generator's hard-coded example (data/generate_examples/main.py)
+    inst = cross_product_instance(
+        categories=["gender", "politics", "education"],
+        features=[
+            ["female", "non-binary", "male"],
+            ["right", "left", "center"],
+            ["higher education", "no higher education"],
+        ],
+        quotas=[
+            [(5, 10), (2, 4), (5, 10)],
+            [(2, 3), (1, 5), (2, 3)],
+            [(2, 3), (5, 10)],
+        ],
+        counts=[1, 10, 6, 4, 8, 3, 9, 1, 10, 4, 10, 11, 12, 3, 5, 2, 5, 3],
+        k=10,
+    )
+    assert inst.n == sum([1, 10, 6, 4, 8, 3, 9, 1, 10, 4, 10, 11, 12, 3, 5, 2, 5, 3])
+    # first combo is (female, right, higher education), one copy
+    assert inst.agents[0] == {
+        "gender": "female",
+        "politics": "right",
+        "education": "higher education",
+    }
+
+
+def test_random_instance_sane_and_roundtrips(tmp_path):
+    inst = random_instance(n=300, k=30, n_categories=4, seed=7)
+    validate_quotas(inst)  # category sums bracket k
+    dense, space = featurize(inst)
+    assert dense.n == 300 and dense.k == 30
+    # round-trip through CSV
+    write_instance_csvs(inst, tmp_path / "rt_30")
+    inst2 = read_instance_dir(tmp_path / "rt_30")
+    assert inst2.k == 30
+    assert inst2.agents == inst.agents
+    assert inst2.categories == inst.categories
+
+
+def test_sf_e_like_shape():
+    inst = sf_e_like_instance()
+    assert inst.n == 1727 and inst.k == 110 and len(inst.categories) == 7
+    validate_quotas(inst)
+
+
+def test_validate_quotas_raises():
+    inst = random_instance(n=50, k=10, n_categories=1, seed=0)
+    cat = list(inst.categories)[0]
+    feats = inst.categories[cat]
+    first = next(iter(feats))
+    feats[first] = (11, 12)  # lower quota alone exceeds k
+    with pytest.raises(SelectionError):
+        validate_quotas(inst)
+
+
+def test_panel_matrix_roundtrip():
+    panels = [(0, 2, 5), (1, 2, 3)]
+    P = panels_to_matrix(panels, n=6)
+    assert P.shape == (2, 6)
+    assert matrix_to_panels(P) == [(0, 2, 5), (1, 2, 3)]

@@ -1,0 +1,113 @@
+import csv
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.instance import featurize, panels_to_matrix
+from citizensassemblies_tpu.ops.pairs import (
+    pair_matrix_from_panels,
+    pair_matrix_from_portfolio,
+    sorted_pair_values,
+    uniform_pair_value,
+)
+from citizensassemblies_tpu.ops.ratio import compute_ratio_products
+from citizensassemblies_tpu.ops.stats import (
+    allocation_from_portfolio,
+    geometric_mean,
+    gini,
+    prob_allocation_stats,
+    share_below,
+    upper_confidence_bound,
+)
+
+
+def reference_gini(probs):
+    # independent re-derivation of the Damgaard-Weiner formula used by the
+    # reference (analysis.py:241-245)
+    n = len(probs)
+    k = round(sum(probs))
+    s = sorted(probs)
+    return sum((2 * i - n + 1) * p for i, p in enumerate(s)) / (n * k)
+
+
+def test_gini_matches_formula():
+    rng = np.random.default_rng(0)
+    probs = rng.uniform(0, 0.4, size=100)
+    probs *= 20 / probs.sum()  # make it sum to a panel size
+    assert gini(probs) == pytest.approx(reference_gini(list(probs)), rel=1e-5)
+
+
+def test_gini_uniform_is_zero():
+    probs = np.full(200, 0.1)
+    assert float(gini(probs)) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_geometric_mean_cap_only_when_asked():
+    probs = np.array([0.0, 0.5, 0.5])
+    capped = float(geometric_mean(probs, cap=True))
+    assert capped == pytest.approx((1e-4 * 0.5 * 0.5) ** (1 / 3), rel=1e-5)
+    assert float(geometric_mean(probs, cap=False)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_upper_confidence_bound_golden():
+    # golden value from reference_output/example_small_20_statistics.txt:7 —
+    # sample proportion 0.0096, 10,000 trials -> 99% UCB 1.21%
+    assert upper_confidence_bound(10_000, 0.0096) == pytest.approx(0.0121, abs=5e-5)
+    assert upper_confidence_bound(100, 1.0) == 1.0
+
+
+def test_allocation_from_portfolio_and_share_below():
+    P = panels_to_matrix([(0, 1), (1, 2)], n=4)
+    probs = np.array([0.25, 0.75])
+    alloc = np.asarray(allocation_from_portfolio(P, probs))
+    assert alloc == pytest.approx([0.25, 1.0, 0.75, 0.0])
+    assert float(share_below(alloc, 0.5)) == pytest.approx(0.5)  # agents 0 and 3
+
+
+def test_pair_matrix_matches_bruteforce():
+    rng = np.random.default_rng(1)
+    n, k, B = 12, 4, 50
+    panels = np.stack([rng.choice(n, size=k, replace=False) for _ in range(B)])
+    M = np.asarray(pair_matrix_from_panels(panels, n=n, chunk=16))
+    brute = np.zeros((n, n))
+    for panel in panels:
+        for i in range(k):
+            for j in range(k):
+                if i != j:
+                    brute[panel[i], panel[j]] += 1
+    np.testing.assert_allclose(M, brute, atol=1e-5)
+    # portfolio-weighted variant agrees with per-panel weights
+    P = panels_to_matrix([p.tolist() for p in panels], n=n)
+    w = rng.uniform(size=B).astype(np.float32)
+    Mw = np.asarray(pair_matrix_from_portfolio(P, w))
+    Mw2 = np.asarray(pair_matrix_from_panels(panels, w, n=n, chunk=7))
+    np.testing.assert_allclose(Mw, Mw2, rtol=1e-4, atol=1e-5)
+
+
+def test_sorted_pair_values_and_uniform():
+    M = np.array([[0, 3, 1], [3, 0, 2], [1, 2, 0]], dtype=float)
+    np.testing.assert_allclose(sorted_pair_values(M), [1, 2, 3])
+    assert uniform_pair_value(3) == pytest.approx(1 / 3)
+
+
+def test_ratio_products_match_golden_csv(example_small, reference_data_dir):
+    # reference_output/example_small_20_ratio_product_data.csv column
+    # "ratio product" is in agent-id order (analysis.py:441-443)
+    golden_path = (
+        reference_data_dir.parent / "reference_output" / "example_small_20_ratio_product_data.csv"
+    )
+    if not golden_path.exists():
+        pytest.skip("golden ratio product CSV missing")
+    with open(golden_path) as fh:
+        golden = [float(row["ratio product"]) for row in csv.DictReader(fh)]
+    dense, _ = featurize(example_small)
+    ours = np.asarray(compute_ratio_products(dense))
+    np.testing.assert_allclose(ours, golden, rtol=2e-5)
+
+
+def test_prob_allocation_stats_bundle():
+    probs = np.full(200, 0.1)
+    stats = prob_allocation_stats(probs, cap_for_geometric_mean=False)
+    assert stats.gini == pytest.approx(0.0, abs=1e-6)
+    assert stats.geometric_mean == pytest.approx(0.1, rel=1e-5)
+    assert stats.min == pytest.approx(0.1, rel=1e-6)
