@@ -1,0 +1,96 @@
+"""Benchmark: LEXIMIN wall-clock on an example_large_200-shaped instance.
+
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+
+The instance mirrors ``data/example_large_200`` (n=2000, k=200, two binary
+categories, quotas 99..200, pool composition 999/1000/1/0 across the four
+intersections — measured from the reference respondents.csv), for which the
+reference's golden median LEXIMIN runtime is 1161.8 s
+(``reference_output/example_large_200_statistics.txt:15``; BASELINE.md).
+``vs_baseline`` is our wall-clock divided by that baseline (< 1 ⇒ faster).
+
+Runs on whatever accelerator JAX finds (TPU under the driver; CPU fallback
+works too). Override the instance with ``BENCH_INSTANCE=small`` for a quick
+smoke run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _example_large_like():
+    from citizensassemblies_tpu.core.generator import cross_product_instance
+
+    # pool composition measured from the reference data: (female,liberal) 999,
+    # (male,conservative) 1000, (female,conservative) 1, (male,liberal) 0
+    return cross_product_instance(
+        categories=["gender", "leaning"],
+        features=[["female", "male"], ["liberal", "conservative"]],
+        quotas=[[(99, 200), (99, 200)], [(99, 200), (99, 200)]],
+        counts=[999, 1, 0, 1000],
+        k=200,
+        name="example_large_200_like",
+    )
+
+
+def _example_small_like():
+    from citizensassemblies_tpu.core.generator import example_small_like_instance
+
+    return example_small_like_instance()
+
+
+BASELINES = {
+    # reference golden median LEXIMIN runtimes (BASELINE.md)
+    "example_large_200_like": 1161.8,
+    "example_small_like_20": 2.7,
+}
+
+
+def main() -> None:
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+    from citizensassemblies_tpu.ops.stats import prob_allocation_stats
+
+    which = os.environ.get("BENCH_INSTANCE", "large")
+    inst = _example_small_like() if which == "small" else _example_large_like()
+    dense, space = featurize(inst)
+
+    # one warm-up on a tiny instance to amortize kernel compilation out of the
+    # measured run (the reference's timing harness also times steady-state
+    # re-runs, analysis.py:625-634)
+    from citizensassemblies_tpu.core.generator import random_instance
+
+    warm = random_instance(n=64, k=8, n_categories=2, seed=0)
+    wdense, wspace = featurize(warm)
+    find_distribution_leximin(wdense, wspace)
+
+    t0 = time.time()
+    dist = find_distribution_leximin(dense, space)
+    elapsed = time.time() - t0
+
+    stats = prob_allocation_stats(dist.allocation, cap_for_geometric_mean=False)
+    baseline = BASELINES[inst.name]
+    print(
+        json.dumps(
+            {
+                "metric": f"leximin_wallclock_{inst.name}",
+                "value": round(elapsed, 2),
+                "unit": "s",
+                "vs_baseline": round(elapsed / baseline, 4),
+                "detail": {
+                    "min_prob": round(stats.min, 5),
+                    "gini": round(stats.gini, 5),
+                    "committees": int(dist.committees.shape[0]),
+                    "baseline_s": baseline,
+                    "speedup": round(baseline / max(elapsed, 1e-9), 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

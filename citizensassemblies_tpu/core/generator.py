@@ -76,12 +76,20 @@ def random_instance(
     assignments: List[np.ndarray] = []
     for ci in range(n_categories):
         m = features_per_category[ci]
+        if n < m:
+            raise ValueError(
+                f"need n >= {m} agents so every feature of category {ci} can appear in the pool"
+            )
         shares = rng.dirichlet([concentration] * m)
-        # ensure every feature actually appears in the pool
+        # ensure every feature actually appears in the pool; repairs only
+        # overwrite indices of features that occur more than once, so one
+        # repair cannot erase another feature's sole occurrence
         labels = rng.choice(m, size=n, p=shares)
         for f in range(m):
             if not np.any(labels == f):
-                labels[rng.integers(n)] = f
+                counts = np.bincount(labels, minlength=m)
+                candidates = np.nonzero(counts[labels] > 1)[0]
+                labels[rng.choice(candidates)] = f
         assignments.append(labels)
         counts = np.bincount(labels, minlength=m)
         pool_shares = counts / n

@@ -1,0 +1,6 @@
+from citizensassemblies_tpu.models.legacy import (  # noqa: F401
+    LegacyResult,
+    legacy_probabilities,
+    sample_feasible_panels,
+    sample_panels_batch,
+)

@@ -1,0 +1,221 @@
+"""LEGACY: the Sortition Foundation's greedy stratified sampler, TPU-native.
+
+The reference implements one panel draw as a Python loop over dict-of-dict
+bookkeeping (``legacy.py:178-200``): k times, pick the (category, feature) cell
+with the highest urgency ratio ``(min - selected) / remaining``
+(``legacy.py:124-157``, first maximum in dict order wins), select a uniformly
+random remaining member of that cell, update per-cell ``selected``/``remaining``
+counters, purge every member of any cell that just hit its upper quota
+(``legacy.py:103-120,47-62``), and raise ``SelectionError`` whenever a cell can
+no longer reach its lower quota; draws failing the final ``check_min_cats``
+(``legacy.py:160-168``) are rejected and redrawn (``analysis.py:141-159``).
+The Monte-Carlo estimator repeats this 10,000 times sequentially
+(``analysis.py:162-191``) — hot loop #1 of the reference.
+
+Here the whole draw is a jittable ``lax.scan`` over k steps on dense count
+tensors, *batched across thousands of chains at once*: per step, one
+``[B, n] @ [n, F]`` matmul recomputes every chain's remaining-counts, a masked
+row-wise argmax picks each chain's urgent cell (the first-max tie-break
+reproduces the reference's dict-order semantics because the flat feature axis
+is in file order), an inverse-CDF gather picks the random member, and the purge
+cascade is a second ``[B, F] @ [F, n]`` matmul. Rejected chains are resampled
+in fresh batches (rejection sampling preserved exactly; per-seed streams differ
+from the reference's ``random``-module draws, but the sampled distribution is
+identical — SURVEY.md §7 "LEGACY fidelity").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import DenseInstance, SelectionError
+from citizensassemblies_tpu.ops.pairs import pair_matrix_from_panels
+from citizensassemblies_tpu.utils.config import Config, default_config
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class LegacyResult:
+    """Monte-Carlo estimate bundle (the triple returned by the reference's
+    ``legacy_probabilities``, ``analysis.py:189-191``)."""
+
+    allocation: np.ndarray  # float64[n] selection frequencies
+    unique_panels: Set[Tuple[int, ...]]
+    pair_matrix: np.ndarray  # float32[n, n] pair co-selection probabilities
+    panels: np.ndarray  # int32[iterations, k] all sampled panels (sorted rows)
+    draws_attempted: int = 0
+
+
+def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, key, scores):
+    """One greedy selection step for a whole batch of chains.
+
+    ``scores`` biases the within-cell member choice: the member picked is
+    ``argmax(scores + Gumbel noise)`` over the urgent cell's alive members.
+    With ``scores ≡ 0`` this is exactly a uniform choice (Gumbel-max trick),
+    reproducing LEGACY's uniform member pick (``legacy.py:149,187-197``); with
+    ``scores = β·y`` it is a softmax(β·y)-weighted pick, which is how the
+    LEXIMIN pricing oracle steers draws toward high-dual-weight agents.
+    """
+    alive, selected, failed = state  # bool[B,n], int32[B,F], bool[B]
+    B = alive.shape[0]
+
+    # remaining per cell: one MXU matmul for the whole batch (the per-cell
+    # "remaining" counters of legacy.py:47-75, recomputed instead of mutated)
+    remaining = (alive.astype(jnp.float32) @ A_f32).astype(jnp.int32)  # [B,F]
+
+    deficit = qmin[None, :] - selected  # min - selected
+    # A cell that cannot reach its lower quota any more means the draw is dead:
+    # covers the "not enough left" checks of legacy.py:55-57,73-74,132-137 and
+    # the ratio > 1 guard of legacy.py:143-144.
+    starved = jnp.any(deficit > remaining, axis=1)
+
+    # urgency ratio over eligible cells (remaining > 0 and max quota > 0,
+    # legacy.py:140-141); first maximum wins as in dict iteration order.
+    eligible = (remaining > 0) & (qmax[None, :] > 0)
+    ratio = jnp.where(eligible, deficit.astype(jnp.float32) / remaining.astype(jnp.float32), NEG_INF)
+    cell = jnp.argmax(ratio, axis=1)  # [B]
+
+    members = alive & (A_T_f32 > 0.5)[cell]  # [B,n]: alive agents in each chain's cell
+    noise = jax.random.gumbel(key, (B, n), dtype=jnp.float32)
+    person = jnp.argmax(jnp.where(members, scores + noise, NEG_INF), axis=1)  # [B]
+
+    person_feats = A_f32[person].astype(jnp.int32)  # [B,F] one-hot per category
+    selected = selected + person_feats
+
+    # purge cascade: every cell of the selected person that just hit its upper
+    # quota evicts all its members (legacy.py:114-119,47-62) — one matmul.
+    purged = (selected == qmax[None, :]) & (person_feats > 0)  # [B,F]
+    kill = (purged.astype(jnp.float32) @ A_T_f32) > 0.5  # [B,n]
+    alive = alive & ~kill
+    alive = alive.at[jnp.arange(B), person].set(False)
+
+    failed = failed | starved
+    return (alive, selected, failed), person
+
+
+@partial(jax.jit, static_argnames=("B",))
+def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None):
+    """Draw B panels in parallel; returns (panels int32[B,k], ok bool[B]).
+
+    ``scores`` is an optional [B, n] (or broadcastable) member-pick bias; see
+    :func:`_sample_step`. ``None`` means uniform picks (plain LEGACY).
+    """
+    n, F, k = dense.n, dense.n_features, dense.k
+    A_f32 = dense.A.astype(jnp.float32)
+    A_T_f32 = A_f32.T
+    qmin, qmax = dense.qmin, dense.qmax
+    if scores is None:
+        scores = jnp.zeros((1, n), dtype=jnp.float32)
+
+    alive0 = jnp.ones((B, n), dtype=bool)
+    selected0 = jnp.zeros((B, F), dtype=jnp.int32)
+    failed0 = jnp.zeros((B,), dtype=bool)
+    step_keys = jax.random.split(key, k)
+
+    def body(state, step_key):
+        alive, selected, failed = state
+        # "run out of people" before the final pick fails the draw
+        # (legacy.py:198-199); checked as part of starvation since an empty
+        # pool starves every unfilled lower quota — but quota-free instances
+        # (all qmin = 0) still need the explicit check.
+        out_of_people = ~jnp.any(alive, axis=1)
+        new_state, person = _sample_step(
+            A_f32, A_T_f32, qmin, qmax, n, state, step_key, scores
+        )
+        alive2, selected2, failed2 = new_state
+        return (alive2, selected2, failed2 | (failed | out_of_people)), person
+
+    (alive, selected, failed), persons = jax.lax.scan(
+        body, (alive0, selected0, failed0), step_keys
+    )
+    panels = persons.T  # [B, k]
+
+    # final lower-quota audit (check_min_cats, legacy.py:160-168)
+    failed = failed | jnp.any(selected < qmin[None, :], axis=1)
+    return panels, ~failed
+
+
+def sample_panels_batch(dense: DenseInstance, key, batch: int, scores=None):
+    """Public jitted batch draw; returns (panels[B,k], ok[B]) as device arrays."""
+    return _sample_panels_kernel(dense, key, batch, scores)
+
+
+def sample_feasible_panels(
+    dense: DenseInstance,
+    num: int,
+    seed: int = 0,
+    cfg: Optional[Config] = None,
+    key=None,
+) -> Tuple[np.ndarray, int]:
+    """Collect ``num`` accepted panels via batched rejection sampling.
+
+    Mirrors the retry-until-valid wrapper ``legacy_find``
+    (``analysis.py:141-159``) but amortized: failed chains simply don't count
+    and fresh batches are drawn until enough successes accumulate. Returns
+    (panels int32[num, k] with *sorted* rows, total draws attempted).
+    """
+    cfg = cfg or default_config()
+    if num <= 0:
+        return np.zeros((0, dense.k), dtype=np.int32), 0
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    B = min(cfg.mc_batch, max(256, num))
+    collected: List[np.ndarray] = []
+    total = 0
+    attempts = 0
+    draws = 0
+    while total < num:
+        key, sub = jax.random.split(key)
+        panels, ok = _sample_panels_kernel(dense, sub, B)
+        ok_np = np.asarray(ok)
+        draws += B
+        good = np.asarray(panels)[ok_np]
+        if good.size:
+            collected.append(good)
+            total += good.shape[0]
+        attempts += 1
+        if attempts > cfg.mc_max_resample_rounds and total == 0:
+            raise SelectionError(
+                f"no feasible panel found in {attempts * B} LEGACY draws — "
+                f"quotas are likely infeasible for greedy selection"
+            )
+    panels = np.concatenate(collected, axis=0)[:num]
+    panels.sort(axis=1)
+    return panels.astype(np.int32), draws
+
+
+def legacy_probabilities(
+    dense: DenseInstance,
+    iterations: int = 10_000,
+    seed: int = 0,
+    cfg: Optional[Config] = None,
+) -> LegacyResult:
+    """Estimate the LEGACY probability allocation from ``iterations`` draws
+    (the Monte-Carlo estimator of ``analysis.py:162-191``).
+
+    Returns per-agent selection frequencies, the set of unique panels observed,
+    and the pair co-selection probability matrix (normalized by the draw count,
+    ``analysis.py:86-88``).
+    """
+    cfg = cfg or default_config()
+    panels, draws = sample_feasible_panels(dense, iterations, seed=seed, cfg=cfg)
+    n = dense.n
+    denom = max(iterations, 1)
+    counts = np.bincount(panels.ravel(), minlength=n)
+    allocation = counts.astype(np.float64) / denom
+    pair_matrix = np.asarray(pair_matrix_from_panels(panels, n=n, chunk=cfg.mc_batch)) / denom
+    unique_panels = set(map(tuple, panels.tolist()))
+    return LegacyResult(
+        allocation=allocation,
+        unique_panels=unique_panels,
+        pair_matrix=pair_matrix,
+        panels=panels,
+        draws_attempted=draws,
+    )

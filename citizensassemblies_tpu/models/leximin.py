@@ -1,0 +1,290 @@
+"""LEXIMIN: exact lexicographic-maximin panel distributions, TPU-first.
+
+The algorithm (mathematically the same as the reference's
+``find_distribution_leximin``, ``leximin.py:338-470``) lexicographically
+maximizes the minimum, then second-minimum, … per-agent selection probability
+over distributions on feasible panels, via column generation:
+
+* an **outer loop** fixes the probabilities of one tranche of agents per round
+  by strict complementarity (agents with positive dual weight must be tight in
+  every optimal primal solution — ``leximin.py:431-443``);
+* an **inner loop** solves the dual LP over the current portfolio and prices
+  new committees until none violates the dual cap (``leximin.py:388-449``);
+* a **final LP** recovers panel probabilities that realize the fixed per-agent
+  probabilities up to a minimized downward deviation ε (``leximin.py:453-468``).
+
+The TPU re-design changes *how each step is executed*, not the math:
+
+* **Portfolio seeding** — instead of 3n sequential multiplicative-weight ILP
+  solves (``leximin.py:236-297``, hot loop #2), one batched device kernel draws
+  thousands of diverse feasible committees at once; a per-uncovered-agent exact
+  solve then guarantees the same coverage property.
+* **Pricing** — instead of one exact ILP per inner iteration
+  (``leximin.py:420-424``, hot loop #3), a jitted sampler prices thousands of
+  candidate committees per batch and adds *several* violated columns per LP
+  solve; the exact oracle only certifies termination, preserving exactness.
+* **LP solves** — dense HiGHS on host ("highs"/"hybrid" backends) or PDHG on
+  device ("jax" backend; see ``solvers/lp_pdhg.py``).
+
+Failure semantics carried over: non-optimal dual LP status triggers the
+shave-fixed-probabilities-and-retry fallback (``leximin.py:405-417``);
+infeasible quotas raise ``InfeasibleQuotasError`` with a suggested relaxation
+(``leximin.py:225-228``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Set, Tuple
+
+import jax
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import DenseInstance, FeatureSpace
+from citizensassemblies_tpu.models.legacy import sample_panels_batch
+from citizensassemblies_tpu.solvers.highs_backend import (
+    HighsCommitteeOracle,
+    check_feasible_or_suggest,
+    solve_dual_lp,
+    solve_final_primal_lp,
+)
+from citizensassemblies_tpu.solvers.pricing import best_violating_panels, stochastic_price
+from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+
+@dataclasses.dataclass
+class Distribution:
+    """A distribution over feasible committees plus derived quantities — the
+    (committees, probabilities, output_lines) triple of the reference's
+    uniform algorithm signature (``leximin.py:341,348-354``), densified."""
+
+    committees: np.ndarray  # bool[|C|, n] portfolio matrix
+    probabilities: np.ndarray  # float64[|C|]
+    allocation: np.ndarray  # float64[n] per-agent selection probabilities
+    output_lines: List[str]
+    fixed_probabilities: np.ndarray  # float64[n] leximin values per agent
+    covered: np.ndarray  # bool[n] agent appears in some feasible committee
+
+    @property
+    def panels(self) -> List[Tuple[int, ...]]:
+        return [tuple(np.nonzero(row)[0].tolist()) for row in self.committees]
+
+    def support(self, eps: float = 1e-11) -> List[Tuple[int, ...]]:
+        """Panels with probability above ``eps`` (``analysis.py:209``)."""
+        return [
+            tuple(np.nonzero(row)[0].tolist())
+            for row, p in zip(self.committees, self.probabilities)
+            if p > eps
+        ]
+
+
+class _Portfolio:
+    """Growing committee portfolio with O(1) dedup."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.rows: List[np.ndarray] = []
+        self.seen: Set[Tuple[int, ...]] = set()
+
+    def add(self, panel: Tuple[int, ...]) -> bool:
+        if panel in self.seen:
+            return False
+        self.seen.add(panel)
+        row = np.zeros(self.n, dtype=bool)
+        row[list(panel)] = True
+        self.rows.append(row)
+        return True
+
+    def matrix(self) -> np.ndarray:
+        return np.stack(self.rows, axis=0)
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+
+def _seed_portfolio(
+    dense: DenseInstance,
+    oracle: HighsCommitteeOracle,
+    portfolio: _Portfolio,
+    cfg: Config,
+    key,
+    log: RunLog,
+) -> np.ndarray:
+    """Seed a diverse portfolio covering every coverable agent.
+
+    Replaces the reference's multiplicative-weights phase + per-uncovered-agent
+    ILPs (``leximin.py:236-297``) with one batched device draw followed by
+    exact coverage solves for the (typically few) agents the batch missed.
+    Returns the bool[n] coverage mask.
+    """
+    n = dense.n
+    budget = max(256, min(cfg.mw_rounds_factor * n, cfg.seed_batch))
+    panels, ok = sample_panels_batch(dense, key, budget)
+    panels = np.sort(np.asarray(panels), axis=1)
+    ok = np.asarray(ok)
+    for b in np.nonzero(ok)[0]:
+        portfolio.add(tuple(panels[b].tolist()))
+    covered = np.zeros(n, dtype=bool)
+    for row in portfolio.rows:
+        covered |= row
+    log.emit(
+        f"Portfolio seeding: batched sampler found {len(portfolio)} distinct feasible "
+        f"committees covering {int(covered.sum())}/{n} agents."
+    )
+
+    # Exact coverage pass for agents the sampler missed: force-include agent i
+    # and maximize coverage of other uncovered agents (the reference solves
+    # one ILP per uncovered agent with objective e_i, leximin.py:279-289).
+    for i in range(n):
+        if covered[i]:
+            continue
+        weights = (~covered).astype(np.float64)
+        try:
+            panel, _ = oracle.maximize(weights, forced=(i,))
+        except Exception:
+            log.emit(f"Agent {i} not contained in any feasible committee.")
+            continue
+        portfolio.add(panel)
+        covered[list(panel)] = True
+    if covered.all():
+        log.emit("All agents are contained in some feasible committee.")
+    return covered
+
+
+def find_distribution_leximin(
+    dense: DenseInstance,
+    space: Optional[FeatureSpace] = None,
+    cfg: Optional[Config] = None,
+    households: Optional[np.ndarray] = None,
+    log: Optional[RunLog] = None,
+    initial_panels: Optional[List[Tuple[int, ...]]] = None,
+    final_stage: str = "lp",
+) -> Distribution:
+    """Compute the exact LEXIMIN distribution over feasible committees.
+
+    ``initial_panels`` warm-starts the portfolio (the capability the reference
+    exposes as ``_expand_distribution_leximin`` for XMIN, ``xmin.py:324-461``).
+    ``final_stage`` selects the probability-recovery objective: "lp" minimizes
+    ε only (``leximin.py:453-464``); "l2" additionally minimizes ``Σ p²`` to
+    spread mass over a maximal support (``xmin.py:454``).
+    """
+    cfg = cfg or default_config()
+    log = log or RunLog(echo=False)
+    log.emit("Using leximin algorithm.")
+    n = dense.n
+
+    if space is None:
+        space = FeatureSpace(categories=(), cells=())
+    oracle = HighsCommitteeOracle(dense, households=households)
+    check_feasible_or_suggest(dense, space, oracle, households)
+
+    key = jax.random.PRNGKey(cfg.solver_seed)
+    portfolio = _Portfolio(n)
+    if initial_panels:
+        for panel in initial_panels:
+            portfolio.add(tuple(sorted(panel)))
+        covered = np.zeros(n, dtype=bool)
+        for row in portfolio.rows:
+            covered |= row
+    else:
+        key, sub = jax.random.split(key)
+        covered = _seed_portfolio(dense, oracle, portfolio, cfg, sub, log)
+
+    fixed = np.full(n, -1.0)  # < 0 ⇒ not yet fixed
+    reduction_counter = 0
+    dual_solves = 0
+    exact_prices = 0
+
+    # Outer loop: maximize the min of unfixed probabilities, fix the tranche of
+    # agents whose dual weight certifies tightness, repeat (leximin.py:381-449).
+    while (fixed < 0).any():
+        log.emit(f"Fixed {int((fixed >= 0).sum())}/{n} probabilities.")
+        while True:
+            P = portfolio.matrix()
+            sol = solve_dual_lp(P, fixed)
+            dual_solves += 1
+            if not sol.ok:
+                # numerically infeasible: shave all fixed probabilities a bit
+                # and retry (leximin.py:405-417)
+                fixed = np.where(fixed >= 0, np.maximum(fixed - cfg.fixed_prob_relax_step, 0.0), fixed)
+                reduction_counter += 1
+                log.emit(f"Dual LP not optimal — reduced fixed probabilities "
+                         f"(reduction {reduction_counter}).")
+                continue
+
+            # fast path: batched stochastic pricing; add several violated
+            # columns per LP solve
+            key, sub = jax.random.split(key)
+            panels, values, ok = stochastic_price(dense, sol.y, sub, cfg=cfg)
+            new = best_violating_panels(
+                panels, values, ok, sol.yhat + cfg.eps, portfolio.seen,
+                max_new=cfg.cg_columns_per_round,
+            )
+            for panel, _val in new:
+                row = np.zeros(n, dtype=bool)
+                row[list(panel)] = True
+                portfolio.rows.append(row)
+            if new:
+                continue
+
+            # certification: exact pricing oracle (leximin.py:420-431)
+            panel, value = oracle.maximize(sol.y)
+            exact_prices += 1
+            log.emit(
+                f"Maximin is at most {sol.objective - sol.yhat + value:.2%}, can do "
+                f"{sol.objective:.2%} with {len(portfolio)} committees. "
+                f"Gap {value - sol.yhat:.2%}."
+            )
+            if value <= sol.yhat + cfg.eps:
+                # portfolio supports an optimal solution: fix every unfixed
+                # agent with certifying dual weight (strict complementarity,
+                # leximin.py:431-443)
+                newly = (sol.y > cfg.eps) & (fixed < 0)
+                if not newly.any():
+                    # numerical guard: the dual weights were too flat to clear
+                    # EPS (can happen for n ≳ 1/EPS); fix the largest-weight
+                    # unfixed agent so the outer loop always progresses
+                    unfixed_idx = np.nonzero(fixed < 0)[0]
+                    newly = np.zeros(n, dtype=bool)
+                    newly[unfixed_idx[np.argmax(sol.y[unfixed_idx])]] = True
+                fixed = np.where(newly, max(0.0, sol.objective), fixed)
+                break
+            else:
+                if not portfolio.add(panel):
+                    # the exact oracle returned a known committee despite a
+                    # positive gap — numerical disagreement between LP and
+                    # ILP; accept the current portfolio as converged
+                    log.emit("Exact oracle repeated a known committee; accepting gap.")
+                    newly = (sol.y > cfg.eps) & (fixed < 0)
+                    if newly.any():
+                        fixed = np.where(newly, max(0.0, sol.objective), fixed)
+                        break
+                    fixed_idx = np.nonzero(fixed < 0)[0]
+                    fixed[fixed_idx[np.argmax(sol.y[fixed_idx])]] = max(0.0, sol.objective)
+                    break
+
+    # Final stage: randomization over the portfolio realizing the fixed
+    # probabilities (leximin.py:451-468; "l2" variant: xmin.py:454).
+    P = portfolio.matrix()
+    if final_stage == "l2":
+        from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
+
+        probs, eps_dev = solve_final_primal_l2(P, fixed)
+    else:
+        probs, eps_dev = solve_final_primal_lp(P, fixed)
+    probs = np.clip(probs, 0.0, 1.0)
+    probs = probs / probs.sum()
+    allocation = P.T.astype(np.float64) @ probs
+    log.emit(
+        f"Leximin done: {len(portfolio)} committees, {dual_solves} dual LP solves, "
+        f"{exact_prices} exact pricing calls, final ε = {eps_dev:.2e}."
+    )
+    return Distribution(
+        committees=P,
+        probabilities=probs,
+        allocation=allocation,
+        output_lines=list(log.lines),
+        fixed_probabilities=fixed,
+        covered=covered,
+    )

@@ -1,0 +1,102 @@
+"""XMIN: LEXIMIN's per-agent probabilities spread over a maximal panel support.
+
+The fork's third algorithm (``xmin.py:484-544``) keeps LEXIMIN's (optimal)
+per-agent selection probabilities but re-distributes the panel probabilities
+over *many more* panels, so repeated assemblies don't keep drawing from the
+same small portfolio. Reference procedure: seed with a full LEXIMIN run
+(``xmin.py:506-508``); then up to 5n times, sample one LEGACY panel not yet in
+the portfolio (≤3n attempts each, ``xmin.py:464-474``), append it, and re-run
+the entire column-generation solve over the grown portfolio with a final QP
+that adds ``Σ p²`` to the objective (``xmin.py:324-461,454``) — hot loop #4,
+by far the reference's most expensive path (O(n) full LP re-solves).
+
+TPU re-design: the portfolio is expanded *in one batched draw* (the device
+sampler produces thousands of distinct feasible panels at once — no reason to
+add them one at a time), the leximin probabilities are computed **once**, and
+the min-L2 final stage runs once over the enlarged portfolio. The quadratic
+final stage is what spreads the mass: its unique optimum puts positive weight
+on every panel that can help realize the targets, which is exactly the support
+-maximization the reference iterates toward. The outer re-solve loop collapses
+because the fixed per-agent probabilities are already leximin-optimal and
+adding columns cannot change them (they are the unique leximin values over the
+*full* feasible-panel polytope, which the portfolio under-approximates tightly
+after certification).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import DenseInstance, FeatureSpace
+from citizensassemblies_tpu.models.legacy import sample_panels_batch
+from citizensassemblies_tpu.models.leximin import Distribution, find_distribution_leximin
+from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
+from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+
+def find_distribution_xmin(
+    dense: DenseInstance,
+    space: Optional[FeatureSpace] = None,
+    cfg: Optional[Config] = None,
+    households: Optional[np.ndarray] = None,
+    log: Optional[RunLog] = None,
+) -> Distribution:
+    """Compute the XMIN distribution: leximin-optimal per-agent probabilities
+    over an expanded, support-maximized portfolio."""
+    cfg = cfg or default_config()
+    log = log or RunLog(echo=False)
+
+    # 1) exact leximin (fixes every agent's probability; xmin.py:506-508)
+    leximin = find_distribution_leximin(
+        dense, space, cfg=cfg, households=households, log=log
+    )
+    n = dense.n
+
+    # 2) portfolio expansion: the reference draws up to 5n fresh LEGACY panels
+    #    one-by-one (xmin.py:511-522); we draw the same budget in batches
+    budget = cfg.xmin_iterations_factor * n
+    seen = {tuple(np.nonzero(row)[0].tolist()) for row in leximin.committees}
+    new_rows: List[np.ndarray] = []
+    key = jax.random.PRNGKey(cfg.solver_seed + 1)
+    drawn = 0
+    while drawn < budget:
+        B = min(cfg.pricing_batch, budget - drawn)
+        key, sub = jax.random.split(key)
+        panels, ok = sample_panels_batch(dense, sub, B)
+        panels = np.sort(np.asarray(panels), axis=1)
+        ok = np.asarray(ok)
+        drawn += B
+        for b in np.nonzero(ok)[0]:
+            tup = tuple(panels[b].tolist())
+            if tup not in seen:
+                seen.add(tup)
+                row = np.zeros(n, dtype=bool)
+                row[list(tup)] = True
+                new_rows.append(row)
+    if new_rows:
+        P = np.concatenate([leximin.committees, np.stack(new_rows)], axis=0)
+    else:
+        P = leximin.committees
+    log.emit(
+        f"XMIN expansion: portfolio grew from {leximin.committees.shape[0]} to "
+        f"{P.shape[0]} committees ({drawn} draws)."
+    )
+
+    # 3) min-L2 redistribution over the grown portfolio (xmin.py:447-455)
+    probs, eps_dev = solve_final_primal_l2(P, leximin.fixed_probabilities)
+    probs = np.clip(probs, 0.0, 1.0)
+    probs = probs / probs.sum()
+    allocation = P.T.astype(np.float64) @ probs
+    log.emit(f"XMIN done: support {(probs > 1e-11).sum()} committees, ε = {eps_dev:.2e}.")
+    return Distribution(
+        committees=P,
+        probabilities=probs,
+        allocation=allocation,
+        output_lines=list(log.lines),
+        fixed_probabilities=leximin.fixed_probabilities,
+        covered=leximin.covered,
+    )

@@ -22,5 +22,9 @@ def compute_ratio_products(dense: DenseInstance) -> jnp.ndarray:
     n = A.shape[0]
     pool_share = jnp.sum(A, axis=0) / n
     quota_midpoint = (dense.qmin + dense.qmax).astype(jnp.float32) / 2.0
-    cell_ratio = pool_share / (quota_midpoint / dense.k)
-    return jnp.exp(A @ jnp.log(cell_ratio))
+    cell_ratio = pool_share * dense.k / jnp.maximum(quota_midpoint, 1e-12)
+    # cells with no pool members never touch any agent's product (A[i,f] = 0);
+    # mask them so 0 * log(0) cannot poison the matvec with NaNs (the
+    # reference only materializes ratios for observed cells, analysis.py:415-425)
+    log_ratio = jnp.where(pool_share > 0, jnp.log(jnp.maximum(cell_ratio, 1e-30)), 0.0)
+    return jnp.exp(A @ log_ratio)

@@ -1,0 +1,5 @@
+from citizensassemblies_tpu.parallel.mesh import make_mesh  # noqa: F401
+from citizensassemblies_tpu.parallel.mc import (  # noqa: F401
+    distributed_allocation,
+    distributed_mc_round,
+)

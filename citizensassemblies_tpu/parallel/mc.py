@@ -1,0 +1,79 @@
+"""Distributed Monte-Carlo and portfolio reductions via shard_map + collectives.
+
+Replaces the reference's sequential 10,000-iteration LEGACY loop
+(``analysis.py:180-187``) with chain-parallel sampling across the device mesh:
+every device draws its own batch of panels with the jitted greedy kernel, and
+the per-agent selection counts plus the n×n pair co-selection matrix are
+reduced with ``psum`` over the ``chains`` axis (ICI collectives — the
+framework's "communication backend", cf. SURVEY.md §5 "Distributed
+communication backend").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from citizensassemblies_tpu.core.instance import DenseInstance
+from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+
+
+def distributed_mc_round(
+    dense: DenseInstance, key, mesh: Mesh, per_device_batch: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One chain-parallel Monte-Carlo round over the mesh.
+
+    Each device draws ``per_device_batch`` panels; returns
+    ``(panels [ndev*B, k], ok [ndev*B], counts [n], pair [n, n])`` where
+    ``counts``/``pair`` are the psum-reduced selection counts and pair
+    co-selection counts of all accepted panels.
+    """
+    n = dense.n
+    ndev = mesh.devices.size
+    keys = jax.random.split(key, ndev)
+
+    # check_vma=False: the sampler's scan carries start replicated and become
+    # device-varying through the per-device keys; skip the varying-axis audit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P(("chains", "agents")),
+        out_specs=(P(("chains", "agents")), P(("chains", "agents")), P(), P()),
+        check_vma=False,
+    )
+    def round_fn(local_keys):
+        panels, ok = _sample_panels_kernel(dense, local_keys[0], per_device_batch)
+        S = jnp.zeros((per_device_batch, n), dtype=jnp.float32)
+        S = S.at[jnp.arange(per_device_batch)[:, None], panels].set(1.0)
+        S = S * ok[:, None].astype(jnp.float32)
+        counts = jax.lax.psum(jnp.sum(S, axis=0), ("chains", "agents"))
+        pair = jax.lax.psum(S.T @ S, ("chains", "agents"))
+        pair = pair * (1.0 - jnp.eye(n, dtype=pair.dtype))
+        return panels, ok, counts, pair
+
+    return round_fn(keys)
+
+
+def distributed_allocation(P_matrix, probs, mesh: Mesh):
+    """π = Pᵀ p with the portfolio row-sharded over the ``chains`` axis and the
+    agent axis sharded over ``agents`` — the layout used by the device LP
+    solver at large portfolio sizes."""
+    P_sharded = jax.device_put(P_matrix, NamedSharding(mesh, P("chains", "agents")))
+    p_sharded = jax.device_put(probs, NamedSharding(mesh, P("chains")))
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("chains", "agents"), P("chains")),
+        out_specs=P("agents"),
+        check_vma=False,
+    )
+    def matvec(P_local, p_local):
+        return jax.lax.psum(P_local.T @ p_local, "chains")
+
+    return matvec(P_sharded, p_sharded)

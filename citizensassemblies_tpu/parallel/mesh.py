@@ -1,0 +1,43 @@
+"""Device mesh construction for the framework's parallel axes.
+
+The reference is single-process and single-threaded (SURVEY.md §2 "Parallelism
+& communication": none of any kind); the TPU framework's parallelism is
+greenfield, specified over two natural axes:
+
+* ``chains`` — data parallelism over Monte-Carlo chains / pricing candidates
+  (the 10k-draw loop at ``analysis.py:180-187`` and the batched pricing oracle),
+  reduced with ``psum`` over ICI.
+* ``agents`` — model parallelism over the agent axis for the n×n pair matrix,
+  portfolio matvecs, and dual-LP iterations at large n.
+
+Multi-host execution uses the same meshes via ``jax.distributed`` +
+``jax.sharding.Mesh`` over all processes' devices; XLA inserts the collectives
+(ICI within a slice, DCN across slices).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Tuple[str, str] = ("chains", "agents"),
+    agents_axis: int = 1,
+) -> Mesh:
+    """Build a (chains × agents) mesh over the first ``n_devices`` devices.
+
+    ``agents_axis`` devices are dedicated to sharding the agent dimension; the
+    rest parallelize chains. Defaults to pure chain parallelism, the right
+    layout for every reference-scale instance (n ≤ 2000 fits one chip).
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = np.asarray(devices[:n])
+    if n % agents_axis != 0:
+        raise ValueError(f"n_devices={n} not divisible by agents_axis={agents_axis}")
+    return Mesh(devices.reshape(n // agents_axis, agents_axis), axis_names)

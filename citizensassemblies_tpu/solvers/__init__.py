@@ -1,0 +1,7 @@
+from citizensassemblies_tpu.solvers.highs_backend import (  # noqa: F401
+    DualSolution,
+    HighsCommitteeOracle,
+    solve_dual_lp,
+    solve_final_primal_lp,
+)
+from citizensassemblies_tpu.solvers.pricing import stochastic_price  # noqa: F401

@@ -1,0 +1,318 @@
+"""Host exact-solver backend on scipy's HiGHS (LPs via ``linprog``, ILPs via
+``milp``).
+
+This fills the role Gurobi + python-mip/CBC play in the reference
+(``leximin.py:16-17``): the committee-feasibility/pricing ILP
+(``leximin.py:190-233``), the quota-relaxation ILP (``leximin.py:90-187``), the
+dual leximin LP (``leximin.py:300-328``), and the final primal LP
+(``leximin.py:453-464``). It is the *certification* path of the framework —
+the TPU backend prices committees stochastically in huge batches and solves
+LPs with PDHG on device; the exact oracle is consulted only to prove that no
+violating committee remains (the dual-gap test at ``leximin.py:429-431``) and
+as a reference implementation in tests.
+
+All problems are expressed on the dense incidence representation: a committee
+is ``x ∈ {0,1}^n`` with ``A.T @ x ∈ [qmin, qmax]`` and ``1.T x = k``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from scipy.optimize import Bounds, LinearConstraint, linprog, milp
+
+from citizensassemblies_tpu.core.instance import (
+    DenseInstance,
+    FeatureSpace,
+    InfeasibleQuotasError,
+    SelectionError,
+)
+
+
+def _constraint_rows(A: np.ndarray, k: int, households: Optional[np.ndarray]):
+    """Shared committee constraint system: size row + per-cell quota rows +
+    optional ≤1-per-household rows (``leximin.py:201-221``)."""
+    n, F = A.shape
+    rows = [np.ones((1, n))]
+    lb = [float(k)]
+    ub = [float(k)]
+    rows.append(A.T.astype(np.float64))
+    if households is not None:
+        for members in _household_groups(households):
+            row = np.zeros((1, n))
+            row[0, members] = 1.0
+            rows.append(row)
+            lb.append(0.0)
+            ub.append(1.0)
+    return rows
+
+
+def _household_groups(households: np.ndarray) -> List[np.ndarray]:
+    groups = []
+    for h in np.unique(households):
+        members = np.nonzero(households == h)[0]
+        if len(members) >= 2:
+            groups.append(members)
+    return groups
+
+
+class HighsCommitteeOracle:
+    """Exact committee oracle: maximize any linear agent-weight objective over
+    feasible committees (the column-generation pricing oracle, used as the
+    reference uses its reusable mip model ``new_committee_model``,
+    ``leximin.py:190-233,420-424``)."""
+
+    def __init__(
+        self,
+        dense: DenseInstance,
+        households: Optional[np.ndarray] = None,
+    ):
+        self.A = np.asarray(dense.A, dtype=np.float64)
+        self.n, self.F = self.A.shape
+        self.k = dense.k
+        self.qmin = np.asarray(dense.qmin, dtype=np.float64)
+        self.qmax = np.asarray(dense.qmax, dtype=np.float64)
+        self.households = households
+
+        mats = [np.ones((1, self.n)), self.A.T]
+        lbs = [np.array([float(self.k)]), self.qmin]
+        ubs = [np.array([float(self.k)]), self.qmax]
+        if households is not None:
+            for members in _household_groups(np.asarray(households)):
+                row = np.zeros((1, self.n))
+                row[0, members] = 1.0
+                mats.append(row)
+                lbs.append(np.array([0.0]))
+                ubs.append(np.array([1.0]))
+        self._mat = np.vstack(mats)
+        self._lb = np.concatenate(lbs)
+        self._ub = np.concatenate(ubs)
+        self._integrality = np.ones(self.n)
+
+    def maximize(
+        self, weights: np.ndarray, forced: Sequence[int] = ()
+    ) -> Tuple[Tuple[int, ...], float]:
+        """Return (committee, value) maximizing ``weights @ x``; ``forced``
+        agents are constrained into the committee (the ``ensure_inclusion``
+        capability, ``leximin.py:104-107,129-133``).
+
+        Raises :class:`SelectionError` if no feasible committee exists under
+        the constraints.
+        """
+        lo = np.zeros(self.n)
+        for i in forced:
+            lo[i] = 1.0
+        res = milp(
+            c=-np.asarray(weights, dtype=np.float64),
+            constraints=LinearConstraint(self._mat, self._lb, self._ub),
+            integrality=self._integrality,
+            bounds=Bounds(lo, np.ones(self.n)),
+        )
+        if res.status != 0 or res.x is None:
+            raise SelectionError(
+                f"committee pricing ILP not solved to optimality (HiGHS status {res.status}: "
+                f"{res.message})"
+            )
+        x = res.x > 0.5
+        committee = tuple(int(i) for i in np.nonzero(x)[0])
+        return committee, float(np.asarray(weights) @ x)
+
+    def check_feasible(self) -> bool:
+        """Solve the pure feasibility problem once (``leximin.py:223-231``)."""
+        try:
+            self.maximize(np.zeros(self.n))
+            return True
+        except SelectionError:
+            return False
+
+
+def relax_infeasible_quotas(
+    dense: DenseInstance,
+    space: FeatureSpace,
+    households: Optional[np.ndarray] = None,
+    ensure_inclusion: Sequence[Sequence[int]] = ((),),
+) -> Tuple[Dict[Tuple[str, str], Tuple[int, int]], List[str]]:
+    """Suggest a minimal quota relaxation making the instance feasible.
+
+    Mirrors the reference's relaxation ILP (``leximin.py:90-187``): integer
+    relaxation variables per feature bound; lowering a small lower quota of
+    old value q costs ``1 + 2/q`` while raising an upper quota costs 1
+    (``leximin.py:152-163``); ``ensure_inclusion`` demands that, for each given
+    agent set, some feasible panel contains it (one committee variable block
+    per set, all sharing the relaxation variables).
+
+    Returns (suggested quotas {(category, feature): (lo, hi)}, advice lines).
+    Raises :class:`SelectionError` if even fully relaxed quotas admit no panel.
+    """
+    A = np.asarray(dense.A, dtype=np.float64)
+    n, F = A.shape
+    k = dense.k
+    qmin = np.asarray(dense.qmin, dtype=np.float64)
+    qmax = np.asarray(dense.qmax, dtype=np.float64)
+    S = len(ensure_inclusion)
+    if S == 0:
+        raise ValueError("ensure_inclusion must contain at least one (possibly empty) set")
+
+    # variable layout: [x_0 .. x_{S-1} blocks of n | min_relax (F) | max_relax (F)]
+    nvars = S * n + 2 * F
+    c = np.zeros(nvars)
+    for f in range(F):
+        old = qmin[f]
+        c[S * n + f] = 0.0 if old == 0 else 1.0 + 2.0 / old
+        c[S * n + F + f] = 1.0
+    lo = np.zeros(nvars)
+    hi = np.ones(nvars)
+    hi[S * n : S * n + F] = qmin  # cannot lower below zero
+    hi[S * n + F :] = float(n)  # raising beyond the pool is pointless
+
+    mats: List[np.ndarray] = []
+    lbs: List[float] = []
+    ubs: List[float] = []
+    for s, inclusion in enumerate(ensure_inclusion):
+        base = s * n
+        row = np.zeros(nvars)
+        row[base : base + n] = 1.0
+        mats.append(row)
+        lbs.append(float(k))
+        ubs.append(float(k))
+        for f in range(F):
+            row = np.zeros(nvars)
+            row[base : base + n] = A[:, f]
+            row[S * n + f] = 1.0  # + min_relax_f ≥ qmin_f
+            mats.append(row)
+            lbs.append(qmin[f])
+            ubs.append(np.inf)
+            row = np.zeros(nvars)
+            row[base : base + n] = A[:, f]
+            row[S * n + F + f] = -1.0  # - max_relax_f ≤ qmax_f
+            mats.append(row)
+            lbs.append(-np.inf)
+            ubs.append(qmax[f])
+        if households is not None:
+            for members in _household_groups(np.asarray(households)):
+                row = np.zeros(nvars)
+                row[base + members] = 1.0
+                mats.append(row)
+                lbs.append(0.0)
+                ubs.append(1.0)
+        for agent in inclusion:
+            lo[base + int(agent)] = 1.0
+
+    res = milp(
+        c=c,
+        constraints=LinearConstraint(np.vstack(mats), np.array(lbs), np.array(ubs)),
+        integrality=np.ones(nvars),
+        bounds=Bounds(lo, hi),
+    )
+    if res.status != 0 or res.x is None:
+        raise SelectionError(
+            f"No feasible committees found even with relaxed quotas (HiGHS status "
+            f"{res.status}). Either the pool is very bad or something is wrong with the solver."
+        )
+
+    lines: List[str] = []
+    new_quotas: Dict[Tuple[str, str], Tuple[int, int]] = {}
+    for f, (cat, feat) in enumerate(space.cells):
+        lower = int(round(qmin[f] - round(res.x[S * n + f])))
+        upper = int(round(qmax[f] + round(res.x[S * n + F + f])))
+        if lower < qmin[f]:
+            lines.append(f"Recommend lowering lower quota of {cat}:{feat} to {lower}.")
+        if upper > qmax[f]:
+            lines.append(f"Recommend raising upper quota of {cat}:{feat} to {upper}.")
+        new_quotas[(cat, feat)] = (lower, upper)
+    return new_quotas, lines
+
+
+def check_feasible_or_suggest(
+    dense: DenseInstance,
+    space: FeatureSpace,
+    oracle: HighsCommitteeOracle,
+    households: Optional[np.ndarray] = None,
+) -> None:
+    """Feasibility gate: on infeasible quotas raise
+    :class:`InfeasibleQuotasError` carrying the suggested relaxation
+    (``leximin.py:223-228``)."""
+    if not oracle.check_feasible():
+        new_quotas, lines = relax_infeasible_quotas(dense, space, households)
+        raise InfeasibleQuotasError(new_quotas, lines)
+
+
+@dataclasses.dataclass
+class DualSolution:
+    ok: bool
+    y: np.ndarray  # float64[n] agent duals
+    yhat: float  # ŷ, the committee cap
+    objective: float  # ŷ - Σ fixed_i y_i
+
+
+def solve_dual_lp(
+    P: np.ndarray,
+    fixed: np.ndarray,
+) -> DualSolution:
+    """Solve the dual leximin LP over the current portfolio.
+
+    minimize    ŷ - Σ_{i fixed} fixed_i · y_i
+    subject to  Σ_{i ∈ C} y_i ≤ ŷ           for each committee row C of P
+                Σ_{i unfixed} y_i = 1
+                y ≥ 0, ŷ ≥ 0
+
+    (the LP of ``leximin.py:300-328``; ``fixed[i] < 0`` marks agent i unfixed).
+    Solved with HiGHS; any non-optimal status returns ``ok=False``, which the
+    caller treats the way the reference treats a non-OPTIMAL Gurobi status —
+    shave the fixed probabilities and retry (``leximin.py:405-417``).
+    """
+    P = np.asarray(P, dtype=np.float64)
+    C, n = P.shape
+    fixed = np.asarray(fixed, dtype=np.float64)
+    unfixed_mask = fixed < 0
+    fixed_vals = np.where(unfixed_mask, 0.0, fixed)
+
+    # variables z = [y_0..y_{n-1}, ŷ]
+    c = np.concatenate([-fixed_vals, [1.0]])
+    A_ub = np.hstack([P, -np.ones((C, 1))])
+    b_ub = np.zeros(C)
+    A_eq = np.concatenate([unfixed_mask.astype(np.float64), [0.0]])[None, :]
+    b_eq = np.array([1.0])
+    res = linprog(
+        c,
+        A_ub=A_ub,
+        b_ub=b_ub,
+        A_eq=A_eq,
+        b_eq=b_eq,
+        bounds=(0, None),
+        method="highs",
+    )
+    if res.status != 0 or res.x is None:
+        return DualSolution(ok=False, y=np.zeros(n), yhat=0.0, objective=0.0)
+    return DualSolution(ok=True, y=res.x[:n], yhat=float(res.x[n]), objective=float(res.fun))
+
+
+def solve_final_primal_lp(P: np.ndarray, target: np.ndarray) -> Tuple[np.ndarray, float]:
+    """Recover committee probabilities realizing the fixed per-agent targets.
+
+    minimize    ε
+    subject to  Σ_C p_C = 1;   (Pᵀ p)_i ≥ target_i - ε  ∀i;   p ≥ 0, ε ≥ 0
+
+    — the reference's numerically-robust final stage, which minimizes the
+    largest downward deviation from the fixed probabilities rather than
+    demanding them exactly (``leximin.py:453-464``).
+    Returns (p, ε).
+    """
+    P = np.asarray(P, dtype=np.float64)
+    C, n = P.shape
+    target = np.asarray(target, dtype=np.float64)
+    # variables [p_0..p_{C-1}, ε]
+    c = np.zeros(C + 1)
+    c[-1] = 1.0
+    A_ub = np.hstack([-P.T, -np.ones((n, 1))])  # -(Pᵀp) - ε ≤ -target
+    b_ub = -target
+    A_eq = np.concatenate([np.ones(C), [0.0]])[None, :]
+    b_eq = np.array([1.0])
+    res = linprog(
+        c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=(0, None), method="highs"
+    )
+    if res.status != 0 or res.x is None:
+        raise SelectionError(f"final primal LP failed (HiGHS status {res.status}: {res.message})")
+    return res.x[:C], float(res.x[C])

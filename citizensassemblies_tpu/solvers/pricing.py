@@ -1,0 +1,88 @@
+"""Stochastic committee pricing on device.
+
+Column generation needs, per inner iteration, a feasible committee maximizing
+``Σ_{i∈C} y_i`` for the current dual weights ``y`` (the reference prices with
+one exact ILP solve per iteration, ``leximin.py:420-424``). On TPU we instead
+draw a *batch* of thousands of quota-feasible committees in one jitted kernel,
+each steered toward high-weight agents with a different inverse temperature
+(softmax-greedy via Gumbel perturbations inside the urgency-greedy sampler),
+and return the best distinct candidates. Any committee with
+``Σ y > ŷ + EPS`` is a violated dual constraint worth adding — stochastic
+pricing only has to *find* violating columns quickly; the exact oracle is
+consulted once at the end to certify that none remain (the termination test of
+``leximin.py:429-443`` keeps its exactness guarantee).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import DenseInstance
+from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+from citizensassemblies_tpu.utils.config import Config, default_config
+
+
+def _pricing_scores(weights: jnp.ndarray, batch: int) -> jnp.ndarray:
+    """[B, n] member-pick scores: β_b · ŵ with a log-spaced β ladder.
+
+    Low β chains explore (near-uniform LEGACY draws keep the portfolio
+    diverse); high β chains exploit (near-greedy on the dual weights y, which
+    is what finds violated constraints when y concentrates on few agents).
+    """
+    w = weights / (jnp.max(jnp.abs(weights)) + 1e-12)
+    betas = jnp.logspace(-1.0, 3.5, batch)
+    return betas[:, None] * w[None, :]
+
+
+def stochastic_price(
+    dense: DenseInstance,
+    weights: np.ndarray,
+    key,
+    batch: Optional[int] = None,
+    cfg: Optional[Config] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sample a batch of feasible committees biased toward high ``weights``.
+
+    Returns ``(panels int32[B,k] sorted rows, values float64[B], ok bool[B])``
+    where ``values[b] = Σ_{i∈panel_b} weights[i]`` (only meaningful where
+    ``ok``).
+    """
+    cfg = cfg or default_config()
+    B = batch or cfg.pricing_batch
+    w = jnp.asarray(weights, dtype=jnp.float32)
+    scores = _pricing_scores(w, B)
+    panels, ok = _sample_panels_kernel(dense, key, B, scores)
+    panels = np.sort(np.asarray(panels), axis=1)
+    values = np.asarray(weights, dtype=np.float64)[panels].sum(axis=1)
+    return panels, values, np.asarray(ok)
+
+
+def best_violating_panels(
+    panels: np.ndarray,
+    values: np.ndarray,
+    ok: np.ndarray,
+    threshold: float,
+    existing: set,
+    max_new: int,
+) -> list:
+    """Pick up to ``max_new`` distinct feasible panels with value above
+    ``threshold`` (= ŷ + EPS), strongest first, skipping panels already in the
+    portfolio. Selected panels are inserted into ``existing`` (the caller's
+    portfolio dedup set)."""
+    order = np.argsort(-values)
+    out = []
+    for idx in order:
+        if len(out) >= max_new:
+            break
+        if not ok[idx] or values[idx] <= threshold:
+            continue
+        tup = tuple(panels[idx].tolist())
+        if tup in existing:
+            continue
+        existing.add(tup)
+        out.append((tup, values[idx]))
+    return out

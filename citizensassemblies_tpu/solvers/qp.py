@@ -1,0 +1,85 @@
+"""Min-norm distribution recovery: a simplex-constrained QP on device.
+
+XMIN's final stage augments the ε-recovery LP with a quadratic term
+``min ε + Σ_C p_C²`` (``xmin.py:447-455``) — the min-L2-norm tie-break that
+spreads probability over as many committees as possible. Here the solve is
+lexicographic instead of summed: first the LP finds the minimal feasible ε
+(``solvers/highs_backend.solve_final_primal_lp``), then this module minimizes
+``Σ p²`` subject to realizing the targets within that ε — the same
+support-spreading effect, with a clean TPU formulation.
+
+The QP  min_{p ∈ Δ, Pᵀp ≥ t - ε} pᵀp  is solved via projected dual ascent:
+for multipliers λ ≥ 0 on the coverage constraints, the inner minimization over
+the simplex has the closed form ``p(λ) = proj_Δ(P λ / 2)``, and the dual
+gradient is the constraint residual — two matvecs per iteration, all jittable
+(``lax.fori_loop``), MXU-friendly, no host round-trips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def project_simplex(v: jnp.ndarray) -> jnp.ndarray:
+    """Euclidean projection onto the probability simplex (sort-based)."""
+    d = v.shape[0]
+    u = jnp.sort(v)[::-1]
+    css = jnp.cumsum(u) - 1.0
+    idx = jnp.arange(1, d + 1, dtype=v.dtype)
+    cond = u - css / idx > 0
+    rho = jnp.sum(cond.astype(jnp.int32)) - 1
+    theta = css[rho] / (rho + 1).astype(v.dtype)
+    return jnp.maximum(v - theta, 0.0)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _min_norm_dual_ascent(P, t, eps, lr, iters: int):
+    C, n = P.shape
+    lam0 = jnp.zeros((n,), dtype=P.dtype)
+
+    def p_of(lam):
+        return project_simplex((P @ lam) / 2.0)
+
+    def body(_, lam):
+        p = p_of(lam)
+        resid = (t - eps) - P.T @ p  # violated ⇒ positive ⇒ raise λ
+        return jnp.maximum(lam + lr * resid, 0.0)
+
+    lam = jax.lax.fori_loop(0, iters, body, lam0)
+    return p_of(lam)
+
+
+def solve_final_primal_l2(
+    P: np.ndarray,
+    target: np.ndarray,
+    iters: int = 20_000,
+    eps_margin: float = 1e-6,
+) -> Tuple[np.ndarray, float]:
+    """Committee probabilities realizing ``target`` within the minimal ε, with
+    minimal L2 norm (maximal spread). Returns (p, ε)."""
+    from citizensassemblies_tpu.solvers.highs_backend import solve_final_primal_lp
+
+    _, eps_star = solve_final_primal_lp(P, target)
+    eps = eps_star + eps_margin
+
+    Pj = jnp.asarray(P, dtype=jnp.float32)
+    tj = jnp.asarray(target, dtype=jnp.float32)
+    # dual-gradient Lipschitz constant ≈ ||P||² / 2; bound via row/col sums
+    k = float(np.max(P.sum(axis=1)))
+    Cn = float(np.max(P.sum(axis=0)))
+    L = max(k * Cn / 2.0, 1.0)
+    p = _min_norm_dual_ascent(Pj, tj, jnp.float32(eps), jnp.float32(1.0 / L), iters)
+    p = np.asarray(p, dtype=np.float64)
+    p = np.clip(p, 0.0, 1.0)
+    s = p.sum()
+    if s <= 0:
+        p = np.full(P.shape[0], 1.0 / P.shape[0])
+    else:
+        p = p / s
+    return p, float(eps_star)

@@ -13,7 +13,6 @@ Here they are all lifted into one frozen dataclass.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,6 +50,11 @@ class Config:
     mw_smooth: float = 0.9
     #: panels sampled per stochastic pricing batch on device.
     pricing_batch: int = 4_096
+    #: cap on the batched portfolio-seeding draw (keeps the first dual LPs
+    #: small; the portfolio grows by pricing only where it matters).
+    seed_batch: int = 1_024
+    #: violated columns added per dual LP solve.
+    cg_columns_per_round: int = 16
     #: maximum committees held in the padded portfolio buffer (static shape).
     max_portfolio: int = 8_192
 
@@ -78,11 +82,5 @@ class Config:
         return dataclasses.replace(self, **kw)
 
 
-_DEFAULT: Optional[Config] = None
-
-
 def default_config() -> Config:
-    global _DEFAULT
-    if _DEFAULT is None:
-        _DEFAULT = Config()
-    return _DEFAULT
+    return Config()

@@ -1,0 +1,155 @@
+"""LEXIMIN correctness: brute-force comparison on tiny instances, golden-value
+checks on reference instances, and property tests (quota feasibility of every
+committee, allocation consistency)."""
+
+import itertools
+
+import numpy as np
+import pytest
+from scipy.optimize import linprog
+
+from citizensassemblies_tpu.core.generator import random_instance
+from citizensassemblies_tpu.core.instance import (
+    InfeasibleQuotasError,
+    featurize,
+    read_instance_dir,
+)
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.ops.stats import prob_allocation_stats
+from citizensassemblies_tpu.utils.config import Config
+
+
+def brute_force_leximin(A, qmin, qmax, k):
+    """Independent exact leximin over the full feasible-panel polytope:
+    iterative primal LPs with per-agent improvement tests (no strict
+    complementarity shortcut)."""
+    n = A.shape[0]
+    panels = [
+        c
+        for c in itertools.combinations(range(n), k)
+        if (A[list(c)].sum(0) >= qmin).all() and (A[list(c)].sum(0) <= qmax).all()
+    ]
+    P = np.zeros((len(panels), n))
+    for r, c in enumerate(panels):
+        P[r, list(c)] = 1
+    fixed = np.full(n, -1.0)
+    while (fixed < 0).any():
+        nv = len(panels) + 1  # [p, z]
+        c_obj = np.zeros(nv)
+        c_obj[-1] = -1
+        A_ub, b_ub = [], []
+        for i in range(n):
+            row = np.zeros(nv)
+            row[: len(panels)] = -P[:, i]
+            if fixed[i] < 0:
+                row[-1] = 1
+                b_ub.append(0.0)
+            else:
+                b_ub.append(-fixed[i])
+            A_ub.append(row)
+        A_eq = np.zeros((1, nv))
+        A_eq[0, : len(panels)] = 1
+        res = linprog(c_obj, A_ub=np.array(A_ub), b_ub=np.array(b_ub), A_eq=A_eq,
+                      b_eq=[1.0], bounds=(0, None), method="highs")
+        z = -res.fun
+        for i in np.nonzero(fixed < 0)[0]:
+            c2 = np.zeros(nv)
+            c2[: len(panels)] = -P[:, i]
+            A_ub2 = A_ub + [np.eye(1, nv, nv - 1)[0] * -1]
+            b_ub2 = b_ub + [-z + 1e-9]
+            r2 = linprog(c2, A_ub=np.array(A_ub2), b_ub=np.array(b_ub2), A_eq=A_eq,
+                         b_eq=[1.0], bounds=(0, None), method="highs")
+            if -r2.fun <= z + 1e-7:
+                fixed[i] = z
+    return fixed
+
+
+def assert_committees_feasible(dist, dense):
+    A = np.asarray(dense.A)
+    qmin = np.asarray(dense.qmin)
+    qmax = np.asarray(dense.qmax)
+    counts = dist.committees.astype(int) @ A
+    assert (dist.committees.sum(axis=1) == dense.k).all()
+    assert (counts >= qmin).all() and (counts <= qmax).all()
+    assert dist.probabilities.sum() == pytest.approx(1.0, abs=1e-9)
+    np.testing.assert_allclose(
+        dist.allocation, dist.committees.T.astype(float) @ dist.probabilities, atol=1e-12
+    )
+
+
+def test_leximin_matches_bruteforce_asymmetric():
+    inst = random_instance(n=12, k=3, n_categories=1, features_per_category=2, seed=2)
+    cat = list(inst.categories)[0]
+    feats = list(inst.categories[cat])
+    for i, agent in enumerate(inst.agents):
+        agent[cat] = feats[0] if i < 9 else feats[1]
+    inst.categories[cat][feats[0]] = (1, 2)
+    inst.categories[cat][feats[1]] = (1, 2)
+    dense, space = featurize(inst)
+    brute = brute_force_leximin(
+        np.asarray(dense.A), np.asarray(dense.qmin), np.asarray(dense.qmax), dense.k
+    )
+    dist = find_distribution_leximin(dense, space)
+    # leximin values: 2/9 for the 9 majority agents, 1/3 for the 3 minority
+    np.testing.assert_allclose(brute[:9], 2 / 9, atol=1e-9)
+    np.testing.assert_allclose(brute[9:], 1 / 3, atol=1e-9)
+    np.testing.assert_allclose(dist.allocation, brute, atol=1e-6)
+    assert_committees_feasible(dist, dense)
+
+
+def test_leximin_matches_bruteforce_random():
+    for seed in (4, 9):
+        inst = random_instance(n=10, k=3, n_categories=2, features_per_category=2, seed=seed)
+        dense, space = featurize(inst)
+        brute = brute_force_leximin(
+            np.asarray(dense.A), np.asarray(dense.qmin), np.asarray(dense.qmax), dense.k
+        )
+        dist = find_distribution_leximin(dense, space)
+        np.testing.assert_allclose(dist.allocation, brute, atol=1e-6)
+        assert_committees_feasible(dist, dense)
+
+
+def test_leximin_example_small_golden(example_small):
+    """Golden: reference_output/example_small_20_statistics.txt — LEXIMIN min
+    10.0%, gini 0.0%, geometric mean 10.0%, ~198 panels in support."""
+    dense, space = featurize(example_small)
+    dist = find_distribution_leximin(dense, space)
+    st = prob_allocation_stats(dist.allocation, cap_for_geometric_mean=False)
+    assert st.min == pytest.approx(0.100, abs=1e-3)
+    assert st.gini == pytest.approx(0.0, abs=1e-3)
+    assert st.geometric_mean == pytest.approx(0.100, abs=1e-3)
+    assert dist.allocation.sum() == pytest.approx(20.0, abs=1e-6)
+    assert len(dist.support()) > 100
+    assert_committees_feasible(dist, dense)
+
+
+def test_leximin_couples_golden(reference_data_dir):
+    """Golden: analysis/couples_..._statistics.txt — LEXIMIN min 10.0%,
+    support 10 panels."""
+    inst = read_instance_dir(
+        reference_data_dir / "couples_panel_from_twenty_people_no_constraints_2"
+    )
+    dense, space = featurize(inst)
+    dist = find_distribution_leximin(dense, space)
+    st = prob_allocation_stats(dist.allocation, cap_for_geometric_mean=False)
+    assert st.min == pytest.approx(0.100, abs=1e-3)
+    assert len(dist.support()) == 10
+    assert_committees_feasible(dist, dense)
+
+
+def test_infeasible_quotas_raise_with_suggestion():
+    inst = random_instance(n=30, k=10, n_categories=1, features_per_category=2, seed=1)
+    cat = list(inst.categories)[0]
+    feats = list(inst.categories[cat])
+    # demand at least 5 members of a feature only 2 agents have
+    for i, agent in enumerate(inst.agents):
+        agent[cat] = feats[0] if i < 2 else feats[1]
+    inst.categories[cat][feats[0]] = (5, 10)
+    inst.categories[cat][feats[1]] = (0, 10)
+    dense, space = featurize(inst)
+    with pytest.raises(InfeasibleQuotasError) as exc:
+        find_distribution_leximin(dense, space)
+    # suggested relaxation must lower the impossible lower quota to ≤ 2
+    quotas = exc.value.quotas
+    assert quotas[(cat, feats[0])][0] <= 2
+    assert any("lowering lower quota" in line for line in exc.value.output)
