@@ -1,0 +1,7 @@
+"""``python -m citizensassemblies_tpu`` — the analysis CLI (reference
+``analysis.py:646-705``)."""
+
+from citizensassemblies_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,169 @@
+"""End-to-end analysis-layer tests: cache (C17), plots (C20/C21), report (C22),
+CLI (C1) against a tiny synthetic instance and the reference-format golden
+layout (filenames/CSV schemas from ``reference_output/``)."""
+
+import csv
+import pickle
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.analysis.cache import (
+    AlgorithmRun,
+    run_legacy_or_retrieve,
+    run_leximin_or_retrieve,
+)
+from citizensassemblies_tpu.analysis.cli import main
+from citizensassemblies_tpu.analysis.report import analyze_instance
+from citizensassemblies_tpu.core.generator import cross_product_instance, write_instance_csvs
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.utils.config import default_config
+
+
+@pytest.fixture(scope="module")
+def tiny_instance():
+    # n=24, k=4: two binary categories, loose quotas — fast exact LEXIMIN
+    return cross_product_instance(
+        categories=["gender", "age"],
+        features=[["f", "m"], ["young", "old"]],
+        quotas=[[(1, 3), (1, 3)], [(1, 3), (1, 3)]],
+        counts=[6, 6, 6, 6],
+        k=4,
+        name="tiny_4",
+    )
+
+
+@pytest.fixture(scope="module")
+def fast_cfg():
+    return default_config().replace(mc_iterations=500, mc_batch=512)
+
+
+def test_cache_roundtrip(tiny_instance, fast_cfg, tmp_path_factory):
+    cache = tmp_path_factory.mktemp("cache")
+    dense, space = featurize(tiny_instance)
+    run1 = run_legacy_or_retrieve(dense, name="tiny", k=4, cache_dir=cache, cfg=fast_cfg)
+    assert (cache / "tiny_4_legacy_first.pickle").exists()
+    run2 = run_legacy_or_retrieve(dense, name="tiny", k=4, cache_dir=cache, cfg=fast_cfg)
+    np.testing.assert_array_equal(run1.allocation, run2.allocation)
+    assert run1.unique_panels == run2.unique_panels
+
+    # payload is plain data, reloadable without the framework's live classes
+    with open(cache / "tiny_4_legacy_first.pickle", "rb") as fh:
+        payload = pickle.load(fh)
+    assert set(payload) >= {"algorithm", "allocation", "unique_panels", "pair_matrix"}
+    rt = AlgorithmRun.from_payload(payload)
+    np.testing.assert_array_equal(rt.allocation, run1.allocation)
+
+
+def test_cache_invalidated_on_config_change(tiny_instance, fast_cfg, tmp_path):
+    dense, _ = featurize(tiny_instance)
+    run1 = run_legacy_or_retrieve(dense, name="tiny", k=4, cache_dir=tmp_path, cfg=fast_cfg)
+    assert run1.num_draws == 500
+    # a different --mc-iterations must recompute, not silently reuse the cache
+    run2 = run_legacy_or_retrieve(
+        dense, name="tiny", k=4, cache_dir=tmp_path,
+        cfg=fast_cfg.replace(mc_iterations=200),
+    )
+    assert run2.num_draws == 200
+    assert abs(run2.allocation.sum() - 4) < 1e-6
+
+
+def test_leximin_cached_allocation_sums_to_k(tiny_instance, fast_cfg, tmp_path):
+    dense, space = featurize(tiny_instance)
+    run = run_leximin_or_retrieve(dense, space, name="tiny", k=4,
+                                  cache_dir=tmp_path, cfg=fast_cfg)
+    assert abs(run.allocation.sum() - 4) < 1e-3
+    # every supported panel satisfies quotas
+    A = np.asarray(dense.A)
+    for panel in run.unique_panels:
+        x = np.zeros(dense.n, dtype=np.float64)
+        x[list(panel)] = 1.0
+        counts = A.T @ x
+        assert (counts >= np.asarray(dense.qmin)).all()
+        assert (counts <= np.asarray(dense.qmax)).all()
+
+
+def test_analyze_instance_end_to_end(tiny_instance, fast_cfg, tmp_path):
+    out = tmp_path / "analysis"
+    result = analyze_instance(
+        tiny_instance,
+        out_dir=out,
+        cache_dir=tmp_path / "distributions",
+        skip_timing=True,
+        cfg=fast_cfg,
+        echo=False,
+    )
+    stem = "tiny_4"
+    stats_txt = (out / f"{stem}_statistics.txt").read_text(encoding="utf-8")
+    # fork statistics.txt layout (analysis/example_small_20_statistics.txt)
+    for needle in [
+        "instance:\ttiny",
+        "pool size n:\t24",
+        "panel size k:\t4",
+        "# quota categories:\t2",
+        "LEGACY minimum probability:",
+        "LEXIMIN minimum probability (exact):",
+        "XMIN minimum probability (exact):",
+        "LEGACY number of unique panels seen:",
+        "gini coefficient of XMIN:",
+        "geometric mean of LEGACY:",
+        "share selected by LEGACY with probability below LEXIMIN",
+        "Skip timing.",
+    ]:
+        assert needle in stats_txt, f"missing line: {needle}"
+
+    for suffix in [
+        "_prob_allocs.pdf",
+        "_prob_allocs_data.csv",
+        "_pair_probability_graph.pdf",
+        "_number_of_unique_panels.pdf",
+        "_ratio_product.pdf",
+        "_ratio_product_data.csv",
+    ]:
+        assert (out / f"{stem}{suffix}").exists(), f"missing output {suffix}"
+
+    # upstream CSV schemas (reference_output/example_small_20_*.csv:1)
+    with open(out / f"{stem}_prob_allocs_data.csv", encoding="utf-8") as fh:
+        header = next(csv.reader(fh))
+    assert header == ["algorithm", "percentile of pool members", "selection probability"]
+    with open(out / f"{stem}_ratio_product_data.csv", encoding="utf-8") as fh:
+        header = next(csv.reader(fh))
+    assert header == ["ratio product", "selection probability"]
+
+    # leximin min prob must dominate the LEGACY minimum (leximin optimality)
+    assert result.stats["leximin"]["min"] >= result.stats["legacy"]["min"] - 1e-6
+    # second analysis pass hits the cache and reproduces identical stats
+    result2 = analyze_instance(
+        tiny_instance, out_dir=out, cache_dir=tmp_path / "distributions",
+        skip_timing=True, cfg=fast_cfg, echo=False,
+    )
+    assert result2.stats == result.stats
+
+
+def test_cli_generate_and_analyze(tmp_path, fast_cfg, monkeypatch):
+    data = tmp_path / "data"
+    # --generate writes the example datasets (reference data/generate_examples)
+    assert main(["--generate", "--data-dir", str(data)]) == 0
+    assert (data / "example_small_20" / "categories.csv").exists()
+    assert (data / "example_large_200" / "respondents.csv").exists()
+
+    # drive a real analysis over a *small custom* instance for speed
+    tiny = cross_product_instance(
+        categories=["g"], features=[["a", "b"]], quotas=[[(1, 3), (1, 3)]],
+        counts=[8, 8], k=4, name="mini_4",
+    )
+    write_instance_csvs(tiny, data / "mini_4")
+    monkeypatch.chdir(tmp_path)
+    rc = main([
+        "mini", "4", "--skiptiming", "--data-dir", str(data),
+        "--out-dir", str(tmp_path / "analysis"),
+        "--cache-dir", str(tmp_path / "distributions"),
+        "--mc-iterations", "300",
+    ])
+    assert rc == 0
+    assert (tmp_path / "analysis" / "mini_4_statistics.txt").exists()
+
+
+def test_cli_rejects_missing_instance(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["nope", "9", "--data-dir", str(tmp_path)])
