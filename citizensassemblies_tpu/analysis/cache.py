@@ -15,6 +15,8 @@ result objects) so caches stay readable across framework versions.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import os
 import pickle
 from pathlib import Path
 from typing import List, Optional, Set, Tuple, Union
@@ -82,8 +84,16 @@ _KEY_FIELDS["xmin"] = _KEY_FIELDS["leximin"] + (
 )
 
 
-def _config_key(cfg: Config, algorithm: str) -> dict:
-    return {f: getattr(cfg, f) for f in _KEY_FIELDS[algorithm]}
+def _config_key(cfg: Config, algorithm: str, households=None) -> dict:
+    key = {f: getattr(cfg, f) for f in _KEY_FIELDS[algorithm]}
+    # household constraints change every algorithm's output; key their digest
+    # so constrained and unconstrained runs are never interchanged
+    key["households"] = (
+        None
+        if households is None
+        else hashlib.sha256(np.asarray(households, dtype=np.int64).tobytes()).hexdigest()
+    )
+    return key
 
 
 def _cache_path(cache_dir: Union[str, Path], name: str, k: int, tag: str) -> Path:
@@ -92,17 +102,22 @@ def _cache_path(cache_dir: Union[str, Path], name: str, k: int, tag: str) -> Pat
 
 def _load_or_compute(path: Optional[Path], compute, config_key: dict) -> AlgorithmRun:
     if path is not None and path.exists():
-        with open(path, "rb") as fh:
-            payload = pickle.load(fh)
-        if payload.get("config_key") == config_key:
+        try:
+            with open(path, "rb") as fh:
+                payload = pickle.load(fh)
+        except Exception:
+            payload = None  # corrupt/truncated cache ⇒ recompute, don't crash
+        if payload is not None and payload.get("config_key") == config_key:
             return AlgorithmRun.from_payload(payload)
     run = compute()
     if path is not None:
         path.parent.mkdir(parents=True, exist_ok=True)
         payload = run.to_payload()
         payload["config_key"] = config_key
-        with open(path, "wb") as fh:
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        with open(tmp, "wb") as fh:
             pickle.dump(payload, fh)
+        os.replace(tmp, path)  # atomic: a crash mid-dump leaves no partial cache
     return run
 
 
@@ -128,6 +143,7 @@ def run_legacy_or_retrieve(
     resample: bool = False,
     cache_dir: Optional[Union[str, Path]] = None,
     cfg: Optional[Config] = None,
+    households: Optional[np.ndarray] = None,
 ) -> AlgorithmRun:
     """Monte-Carlo LEGACY estimate, memoized (``analysis.py:271-293``).
 
@@ -140,7 +156,8 @@ def run_legacy_or_retrieve(
     path = _cache_path(cache_dir, name, k, tag) if cache_dir is not None else None
 
     def compute() -> AlgorithmRun:
-        res = legacy_probabilities(dense, iterations=cfg.mc_iterations, seed=seed, cfg=cfg)
+        res = legacy_probabilities(dense, iterations=cfg.mc_iterations, seed=seed, cfg=cfg,
+                                   households=households)
         run = AlgorithmRun(
             algorithm="legacy",
             allocation=res.allocation,
@@ -152,7 +169,7 @@ def run_legacy_or_retrieve(
         assert abs(run.allocation.sum() - k) < 1e-6 * k + 1e-6  # analysis.py:292
         return run
 
-    return _load_or_compute(path, compute, _config_key(cfg, "legacy"))
+    return _load_or_compute(path, compute, _config_key(cfg, "legacy", households))
 
 
 def run_leximin_or_retrieve(
@@ -176,7 +193,7 @@ def run_leximin_or_retrieve(
         assert abs(run.allocation.sum() - k) < 1e-4 * k + 1e-4  # analysis.py:326
         return run
 
-    return _load_or_compute(path, compute, _config_key(cfg, "leximin"))
+    return _load_or_compute(path, compute, _config_key(cfg, "leximin", households))
 
 
 def run_xmin_or_retrieve(
@@ -200,4 +217,4 @@ def run_xmin_or_retrieve(
         assert abs(run.allocation.sum() - k) < 1e-4 * k + 1e-4  # analysis.py:309
         return run
 
-    return _load_or_compute(path, compute, _config_key(cfg, "xmin"))
+    return _load_or_compute(path, compute, _config_key(cfg, "xmin", households))

@@ -2,6 +2,7 @@ from citizensassemblies_tpu.core.instance import (  # noqa: F401
     DenseInstance,
     FeatureSpace,
     Instance,
+    compute_households,
     featurize,
     read_instance,
     validate_quotas,

@@ -242,6 +242,31 @@ def validate_quotas(instance: Instance) -> None:
             raise SelectionError(f"upper quotas of category {cat!r} sum to {hi} < k={instance.k}")
 
 
+def compute_households(
+    instance: Instance, address_columns: Sequence[str]
+) -> np.ndarray:
+    """Group agents into households by equality on the address columns
+    (the reference's ``_compute_households``, ``leximin.py:359-362``, and the
+    same-address matching of ``legacy.py:78-99``, which compares the two
+    ``check_same_address_columns`` values of every pair).
+
+    Returns int32[n] household ids suitable for the samplers' and oracles'
+    ``households`` argument. Requires the instance to have been read with
+    ``extra_columns=address_columns``.
+    """
+    if not instance.columns_data:
+        raise ValueError(
+            "instance has no columns_data — re-read it with "
+            f"extra_columns={list(address_columns)!r} to enable household checks"
+        )
+    ids: Dict[Tuple[str, ...], int] = {}
+    out = np.zeros(len(instance.agents), dtype=np.int32)
+    for i, cols in enumerate(instance.columns_data):
+        key = tuple(cols.get(c, "") for c in address_columns)
+        out[i] = ids.setdefault(key, len(ids))
+    return out
+
+
 def panels_to_matrix(panels: Sequence[Sequence[int]], n: int) -> np.ndarray:
     """Stack agent-index panels into a binary portfolio matrix P ∈ {0,1}^{|C|×n}."""
     P = np.zeros((len(panels), n), dtype=bool)

@@ -53,7 +53,7 @@ class LegacyResult:
     draws_attempted: int = 0
 
 
-def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, key, scores):
+def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, key, scores, households):
     """One greedy selection step for a whole batch of chains.
 
     ``scores`` biases the within-cell member choice: the member picked is
@@ -62,6 +62,10 @@ def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, key, scores):
     reproducing LEGACY's uniform member pick (``legacy.py:149,187-197``); with
     ``scores = β·y`` it is a softmax(β·y)-weighted pick, which is how the
     LEXIMIN pricing oracle steers draws toward high-dual-weight agents.
+
+    ``households`` is int32[n] group ids; selecting an agent evicts everyone
+    in their household (the same-address deletion of ``legacy.py:78-99,
+    109-113``). With distinct ids per agent it evicts only the agent.
     """
     alive, selected, failed = state  # bool[B,n], int32[B,F], bool[B]
     B = alive.shape[0]
@@ -94,18 +98,21 @@ def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, key, scores):
     purged = (selected == qmax[None, :]) & (person_feats > 0)  # [B,F]
     kill = (purged.astype(jnp.float32) @ A_T_f32) > 0.5  # [B,n]
     alive = alive & ~kill
-    alive = alive.at[jnp.arange(B), person].set(False)
+    # evict the selected person and all same-household members
+    alive = alive & (households[None, :] != households[person][:, None])
 
     failed = failed | starved
     return (alive, selected, failed), person
 
 
 @partial(jax.jit, static_argnames=("B",))
-def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None):
+def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None, households=None):
     """Draw B panels in parallel; returns (panels int32[B,k], ok bool[B]).
 
     ``scores`` is an optional [B, n] (or broadcastable) member-pick bias; see
     :func:`_sample_step`. ``None`` means uniform picks (plain LEGACY).
+    ``households`` is an optional int32[n] group-id vector enabling the
+    reference's ``check_same_address`` behavior (``legacy.py:78-99``).
     """
     n, F, k = dense.n, dense.n_features, dense.k
     A_f32 = dense.A.astype(jnp.float32)
@@ -113,6 +120,10 @@ def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None):
     qmin, qmax = dense.qmin, dense.qmax
     if scores is None:
         scores = jnp.zeros((1, n), dtype=jnp.float32)
+    if households is None:
+        households = jnp.arange(n, dtype=jnp.int32)
+    else:
+        households = jnp.asarray(households, dtype=jnp.int32)
 
     alive0 = jnp.ones((B, n), dtype=bool)
     selected0 = jnp.zeros((B, F), dtype=jnp.int32)
@@ -127,7 +138,7 @@ def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None):
         # (all qmin = 0) still need the explicit check.
         out_of_people = ~jnp.any(alive, axis=1)
         new_state, person = _sample_step(
-            A_f32, A_T_f32, qmin, qmax, n, state, step_key, scores
+            A_f32, A_T_f32, qmin, qmax, n, state, step_key, scores, households
         )
         alive2, selected2, failed2 = new_state
         return (alive2, selected2, failed2 | (failed | out_of_people)), person
@@ -142,9 +153,9 @@ def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None):
     return panels, ~failed
 
 
-def sample_panels_batch(dense: DenseInstance, key, batch: int, scores=None):
+def sample_panels_batch(dense: DenseInstance, key, batch: int, scores=None, households=None):
     """Public jitted batch draw; returns (panels[B,k], ok[B]) as device arrays."""
-    return _sample_panels_kernel(dense, key, batch, scores)
+    return _sample_panels_kernel(dense, key, batch, scores, households)
 
 
 def sample_feasible_panels(
@@ -153,6 +164,7 @@ def sample_feasible_panels(
     seed: int = 0,
     cfg: Optional[Config] = None,
     key=None,
+    households: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, int]:
     """Collect ``num`` accepted panels via batched rejection sampling.
 
@@ -173,7 +185,7 @@ def sample_feasible_panels(
     draws = 0
     while total < num:
         key, sub = jax.random.split(key)
-        panels, ok = _sample_panels_kernel(dense, sub, B)
+        panels, ok = _sample_panels_kernel(dense, sub, B, households=households)
         ok_np = np.asarray(ok)
         draws += B
         good = np.asarray(panels)[ok_np]
@@ -196,6 +208,7 @@ def legacy_probabilities(
     iterations: int = 10_000,
     seed: int = 0,
     cfg: Optional[Config] = None,
+    households: Optional[np.ndarray] = None,
 ) -> LegacyResult:
     """Estimate the LEGACY probability allocation from ``iterations`` draws
     (the Monte-Carlo estimator of ``analysis.py:162-191``).
@@ -205,7 +218,7 @@ def legacy_probabilities(
     ``analysis.py:86-88``).
     """
     cfg = cfg or default_config()
-    panels, draws = sample_feasible_panels(dense, iterations, seed=seed, cfg=cfg)
+    panels, draws = sample_feasible_panels(dense, iterations, seed=seed, cfg=cfg, households=households)
     n = dense.n
     denom = max(iterations, 1)
     counts = np.bincount(panels.ravel(), minlength=n)

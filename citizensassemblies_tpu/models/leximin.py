@@ -38,6 +38,7 @@ import dataclasses
 from typing import List, Optional, Set, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.core.instance import DenseInstance, FeatureSpace
@@ -49,8 +50,16 @@ from citizensassemblies_tpu.solvers.highs_backend import (
     solve_final_primal_lp,
 )
 from citizensassemblies_tpu.solvers.pricing import best_violating_panels, stochastic_price
+from citizensassemblies_tpu.utils.checkpoint import (
+    CGState,
+    clear_cg_state,
+    load_cg_state,
+    problem_fingerprint,
+    save_cg_state,
+)
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.logging import RunLog
+from citizensassemblies_tpu.utils.profiling import format_timers
 
 
 @dataclasses.dataclass
@@ -110,6 +119,7 @@ def _seed_portfolio(
     cfg: Config,
     key,
     log: RunLog,
+    households: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Seed a diverse portfolio covering every coverable agent.
 
@@ -120,7 +130,7 @@ def _seed_portfolio(
     """
     n = dense.n
     budget = max(256, min(cfg.mw_rounds_factor * n, cfg.seed_batch))
-    panels, ok = sample_panels_batch(dense, key, budget)
+    panels, ok = sample_panels_batch(dense, key, budget, households=households)
     panels = np.sort(np.asarray(panels), axis=1)
     ok = np.asarray(ok)
     for b in np.nonzero(ok)[0]:
@@ -160,6 +170,7 @@ def find_distribution_leximin(
     log: Optional[RunLog] = None,
     initial_panels: Optional[List[Tuple[int, ...]]] = None,
     final_stage: str = "lp",
+    checkpoint_path: Optional[str] = None,
 ) -> Distribution:
     """Compute the exact LEXIMIN distribution over feasible committees.
 
@@ -168,6 +179,10 @@ def find_distribution_leximin(
     ``final_stage`` selects the probability-recovery objective: "lp" minimizes
     ε only (``leximin.py:453-464``); "l2" additionally minimizes ``Σ p²`` to
     spread mass over a maximal support (``xmin.py:454``).
+    ``checkpoint_path`` enables outer-round checkpointing: state is saved
+    there after every fixed tranche and restored on restart, so a preempted
+    long run resumes instead of recomputing from zero (SURVEY §5 — capability
+    the reference lacks). The file is removed on successful completion.
     """
     cfg = cfg or default_config()
     log = log or RunLog(echo=False)
@@ -181,28 +196,61 @@ def find_distribution_leximin(
 
     key = jax.random.PRNGKey(cfg.solver_seed)
     portfolio = _Portfolio(n)
-    if initial_panels:
-        for panel in initial_panels:
-            portfolio.add(tuple(sorted(panel)))
-        covered = np.zeros(n, dtype=bool)
-        for row in portfolio.rows:
-            covered |= row
+    resumed = None
+    ckpt_fp = ""
+    if checkpoint_path is not None:
+        ckpt_fp = problem_fingerprint(dense, cfg, households)
+        resumed = load_cg_state(checkpoint_path, n, ckpt_fp)
+    if resumed is not None:
+        for row in resumed.portfolio:
+            portfolio.add(tuple(np.nonzero(row)[0].tolist()))
+        covered = resumed.covered
+        fixed = resumed.fixed
+        key = jnp.asarray(resumed.key, dtype=jnp.uint32)  # raw PRNGKey data
+        reduction_counter = resumed.reduction_counter
+        dual_solves = resumed.dual_solves
+        exact_prices = resumed.exact_prices
+        log.emit(
+            f"Resumed checkpoint: {len(portfolio)} committees, "
+            f"{int((fixed >= 0).sum())}/{n} probabilities already fixed."
+        )
     else:
-        key, sub = jax.random.split(key)
-        covered = _seed_portfolio(dense, oracle, portfolio, cfg, sub, log)
-
-    fixed = np.full(n, -1.0)  # < 0 ⇒ not yet fixed
-    reduction_counter = 0
-    dual_solves = 0
-    exact_prices = 0
+        if initial_panels:
+            for panel in initial_panels:
+                portfolio.add(tuple(sorted(panel)))
+            covered = np.zeros(n, dtype=bool)
+            for row in portfolio.rows:
+                covered |= row
+        else:
+            key, sub = jax.random.split(key)
+            covered = _seed_portfolio(dense, oracle, portfolio, cfg, sub, log, households)
+        fixed = np.full(n, -1.0)  # < 0 ⇒ not yet fixed
+        reduction_counter = 0
+        dual_solves = 0
+        exact_prices = 0
 
     # Outer loop: maximize the min of unfixed probabilities, fix the tranche of
     # agents whose dual weight certifies tightness, repeat (leximin.py:381-449).
     while (fixed < 0).any():
         log.emit(f"Fixed {int((fixed >= 0).sum())}/{n} probabilities.")
+        if checkpoint_path is not None:
+            save_cg_state(
+                checkpoint_path,
+                CGState(
+                    portfolio=portfolio.matrix() if len(portfolio) else np.zeros((0, n), bool),
+                    fixed=fixed,
+                    covered=covered,
+                    key=np.asarray(key),
+                    reduction_counter=reduction_counter,
+                    dual_solves=dual_solves,
+                    exact_prices=exact_prices,
+                    fingerprint=ckpt_fp,
+                ),
+            )
         while True:
             P = portfolio.matrix()
-            sol = solve_dual_lp(P, fixed)
+            with log.timer("dual_lp"):
+                sol = solve_dual_lp(P, fixed)
             dual_solves += 1
             if not sol.ok:
                 # numerically infeasible: shave all fixed probabilities a bit
@@ -216,7 +264,8 @@ def find_distribution_leximin(
             # fast path: batched stochastic pricing; add several violated
             # columns per LP solve
             key, sub = jax.random.split(key)
-            panels, values, ok = stochastic_price(dense, sol.y, sub, cfg=cfg)
+            with log.timer("stochastic_pricing"):
+                panels, values, ok = stochastic_price(dense, sol.y, sub, cfg=cfg, households=households)
             new = best_violating_panels(
                 panels, values, ok, sol.yhat + cfg.eps, portfolio.seen,
                 max_new=cfg.cg_columns_per_round,
@@ -229,7 +278,8 @@ def find_distribution_leximin(
                 continue
 
             # certification: exact pricing oracle (leximin.py:420-431)
-            panel, value = oracle.maximize(sol.y)
+            with log.timer("exact_oracle"):
+                panel, value = oracle.maximize(sol.y)
             exact_prices += 1
             log.emit(
                 f"Maximin is at most {sol.objective - sol.yhat + value:.2%}, can do "
@@ -267,12 +317,13 @@ def find_distribution_leximin(
     # Final stage: randomization over the portfolio realizing the fixed
     # probabilities (leximin.py:451-468; "l2" variant: xmin.py:454).
     P = portfolio.matrix()
-    if final_stage == "l2":
-        from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
+    with log.timer("final_stage"):
+        if final_stage == "l2":
+            from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
 
-        probs, eps_dev = solve_final_primal_l2(P, fixed)
-    else:
-        probs, eps_dev = solve_final_primal_lp(P, fixed)
+            probs, eps_dev = solve_final_primal_l2(P, fixed)
+        else:
+            probs, eps_dev = solve_final_primal_lp(P, fixed)
     probs = np.clip(probs, 0.0, 1.0)
     probs = probs / probs.sum()
     allocation = P.T.astype(np.float64) @ probs
@@ -280,6 +331,9 @@ def find_distribution_leximin(
         f"Leximin done: {len(portfolio)} committees, {dual_solves} dual LP solves, "
         f"{exact_prices} exact pricing calls, final ε = {eps_dev:.2e}."
     )
+    log.emit(format_timers(log.timers))
+    if checkpoint_path is not None:
+        clear_cg_state(checkpoint_path)
     return Distribution(
         committees=P,
         probabilities=probs,

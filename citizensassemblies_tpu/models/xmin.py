@@ -66,7 +66,7 @@ def find_distribution_xmin(
     while drawn < budget:
         B = min(cfg.pricing_batch, budget - drawn)
         key, sub = jax.random.split(key)
-        panels, ok = sample_panels_batch(dense, sub, B)
+        panels, ok = sample_panels_batch(dense, sub, B, households=households)
         panels = np.sort(np.asarray(panels), axis=1)
         ok = np.asarray(ok)
         drawn += B

@@ -44,6 +44,7 @@ def stochastic_price(
     key,
     batch: Optional[int] = None,
     cfg: Optional[Config] = None,
+    households: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Sample a batch of feasible committees biased toward high ``weights``.
 
@@ -55,7 +56,7 @@ def stochastic_price(
     B = batch or cfg.pricing_batch
     w = jnp.asarray(weights, dtype=jnp.float32)
     scores = _pricing_scores(w, B)
-    panels, ok = _sample_panels_kernel(dense, key, B, scores)
+    panels, ok = _sample_panels_kernel(dense, key, B, scores, households)
     panels = np.sort(np.asarray(panels), axis=1)
     values = np.asarray(weights, dtype=np.float64)[panels].sum(axis=1)
     return panels, values, np.asarray(ok)

@@ -1,0 +1,111 @@
+"""Mid-algorithm checkpointing of column-generation state.
+
+The reference only memoizes *finished* runs (``analysis.py:271-327``), so a
+crashed 4,000-second LEXIMIN run restarts from zero (SURVEY §5). Here the CG
+state — portfolio matrix, fixed-probability vector, coverage mask, RNG key and
+counters — is saved between outer rounds as one ``.npz`` and restored on the
+next call, so a preempted run resumes at its last fixed tranche.
+
+Atomic write (tmp + rename) so a crash mid-save never corrupts the previous
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import Optional, Union
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CGState:
+    """Column-generation state at an outer-round boundary."""
+
+    portfolio: np.ndarray  # bool[|C|, n]
+    fixed: np.ndarray  # float64[n]; < 0 ⇒ not yet fixed
+    covered: np.ndarray  # bool[n]
+    key: np.ndarray  # jax PRNGKey data
+    reduction_counter: int = 0
+    dual_solves: int = 0
+    exact_prices: int = 0
+    #: hash of (instance, config, households); a checkpoint only resumes into
+    #: the identical problem — see :func:`problem_fingerprint`
+    fingerprint: str = ""
+
+
+def problem_fingerprint(dense, cfg, households=None) -> str:
+    """Digest of everything that determines the CG trajectory: incidence
+    matrix, quotas, k, solver config, household groups. A checkpoint written
+    under any other problem must not be resumed (same hazard class as the
+    cache layer's config key)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.asarray(dense.A).astype(np.uint8).tobytes())
+    h.update(np.asarray(dense.qmin).tobytes())
+    h.update(np.asarray(dense.qmax).tobytes())
+    h.update(str(dense.k).encode())
+    h.update(repr(cfg).encode())
+    if households is not None:
+        h.update(np.asarray(households, dtype=np.int64).tobytes())
+    return h.hexdigest()
+
+
+def save_cg_state(path: Union[str, Path], state: CGState) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(
+            fh,
+            portfolio=state.portfolio.astype(bool),
+            fixed=state.fixed.astype(np.float64),
+            covered=state.covered.astype(bool),
+            key=np.asarray(state.key),
+            counters=np.asarray(
+                [state.reduction_counter, state.dual_solves, state.exact_prices],
+                dtype=np.int64,
+            ),
+            fingerprint=np.frombuffer(state.fingerprint.encode(), dtype=np.uint8),
+        )
+    os.replace(tmp, path)
+
+
+def load_cg_state(
+    path: Union[str, Path], n: int, fingerprint: str = ""
+) -> Optional[CGState]:
+    """Load a checkpoint if present and written for the *same problem*
+    (matching pool size and, when given, matching :func:`problem_fingerprint`).
+    A checkpoint for a different problem — or a corrupt file — is ignored, not
+    an error: the caller just starts fresh."""
+    path = Path(path)
+    if not path.exists():
+        return None
+    try:
+        with np.load(path) as z:
+            portfolio = z["portfolio"]
+            if portfolio.ndim != 2 or portfolio.shape[1] != n:
+                return None
+            stored_fp = bytes(z["fingerprint"]).decode() if "fingerprint" in z else ""
+            if fingerprint and stored_fp != fingerprint:
+                return None
+            counters = z["counters"]
+            return CGState(
+                portfolio=portfolio.astype(bool),
+                fixed=z["fixed"],
+                covered=z["covered"],
+                key=z["key"],
+                reduction_counter=int(counters[0]),
+                dual_solves=int(counters[1]),
+                exact_prices=int(counters[2]),
+                fingerprint=stored_fp,
+            )
+    except Exception:
+        return None
+
+
+def clear_cg_state(path: Union[str, Path]) -> None:
+    Path(path).unlink(missing_ok=True)

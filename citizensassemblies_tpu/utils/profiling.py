@@ -1,0 +1,52 @@
+"""Tracing / profiling (SURVEY §5).
+
+The reference's only instrumentation is a wall-clock timing harness
+(``analysis.py:625-634``) and periodic progress prints. The TPU build adds:
+
+* :func:`profiler_trace` — wraps ``jax.profiler.trace`` so any region can be
+  captured for TensorBoard/Perfetto (XLA compile + device timelines).
+* :func:`annotate` — ``jax.profiler.TraceAnnotation`` context for named spans
+  inside a trace.
+* Per-phase wall timers live on :class:`~citizensassemblies_tpu.utils.logging.RunLog`
+  (``log.timer("dual_lp")``), which the solvers use to attribute CG time to
+  dual solves / pricing / exact certification; :func:`format_timers` renders
+  them for the in-band output-lines channel.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager, nullcontext
+from typing import Dict, Optional
+
+
+@contextmanager
+def profiler_trace(logdir: Optional[str]):
+    """Capture a jax profiler trace into ``logdir`` (no-op when ``None``)."""
+    if logdir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(logdir)):
+        yield
+
+
+def annotate(name: str):
+    """Named span inside a profiler trace (host + device timeline)."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover
+        return nullcontext()
+
+
+def format_timers(timers: Dict[str, float]) -> str:
+    """One-line phase-time attribution, largest first."""
+    if not timers:
+        return "phase times: (none recorded)"
+    parts = [
+        f"{name} {secs:.2f}s"
+        for name, secs in sorted(timers.items(), key=lambda kv: -kv[1])
+    ]
+    return "phase times: " + ", ".join(parts)

@@ -68,6 +68,31 @@ def test_cache_invalidated_on_config_change(tiny_instance, fast_cfg, tmp_path):
     assert abs(run2.allocation.sum() - 4) < 1e-6
 
 
+def test_corrupt_cache_recomputes(tiny_instance, fast_cfg, tmp_path):
+    dense, _ = featurize(tiny_instance)
+    run1 = run_legacy_or_retrieve(dense, name="tiny", k=4, cache_dir=tmp_path, cfg=fast_cfg)
+    path = tmp_path / "tiny_4_legacy_first.pickle"
+    path.write_bytes(b"\x80truncated")  # simulate a crash mid-write of old code
+    run2 = run_legacy_or_retrieve(dense, name="tiny", k=4, cache_dir=tmp_path, cfg=fast_cfg)
+    np.testing.assert_array_equal(run1.allocation, run2.allocation)
+    # and the repaired cache is loadable again
+    run3 = run_legacy_or_retrieve(dense, name="tiny", k=4, cache_dir=tmp_path, cfg=fast_cfg)
+    np.testing.assert_array_equal(run2.allocation, run3.allocation)
+
+
+def test_households_change_cache_key(tiny_instance, fast_cfg, tmp_path):
+    dense, _ = featurize(tiny_instance)
+    baseline = run_legacy_or_retrieve(dense, name="tiny", k=4,
+                                      cache_dir=tmp_path, cfg=fast_cfg)
+    h = np.repeat(np.arange(12), 2).astype(np.int32)  # 12 households of 2
+    constrained = run_legacy_or_retrieve(dense, name="tiny", k=4, cache_dir=tmp_path,
+                                         cfg=fast_cfg, households=h)
+    # the constrained run must NOT be served from the unconstrained cache
+    for panel in constrained.unique_panels:
+        assert len(set(h[list(panel)])) == len(panel)
+    assert not np.array_equal(baseline.allocation, constrained.allocation)
+
+
 def test_leximin_cached_allocation_sums_to_k(tiny_instance, fast_cfg, tmp_path):
     dense, space = featurize(tiny_instance)
     run = run_leximin_or_retrieve(dense, space, name="tiny", k=4,

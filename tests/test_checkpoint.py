@@ -1,0 +1,121 @@
+"""Outer-round CG checkpointing: save/load/clear roundtrip, resume semantics,
+and cleanup on successful completion (capability beyond the reference's
+finished-run-only pickle cache — SURVEY §5)."""
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import cross_product_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.utils.checkpoint import (
+    CGState,
+    clear_cg_state,
+    load_cg_state,
+    problem_fingerprint,
+    save_cg_state,
+)
+from citizensassemblies_tpu.utils.logging import RunLog
+
+
+@pytest.fixture(scope="module")
+def small():
+    inst = cross_product_instance(
+        categories=["gender", "age"],
+        features=[["f", "m"], ["y", "o"]],
+        quotas=[[(2, 4), (2, 4)], [(2, 4), (2, 4)]],
+        counts=[8, 8, 8, 8],
+        k=6,
+        name="ckpt_6",
+    )
+    return featurize(inst)
+
+
+def test_save_load_clear_roundtrip(tmp_path):
+    path = tmp_path / "cg.npz"
+    state = CGState(
+        portfolio=np.eye(4, 10, dtype=bool),
+        fixed=np.array([0.1, -1.0, 0.2, -1.0, 0.3, -1.0, 0.1, 0.1, -1.0, 0.2]),
+        covered=np.ones(10, dtype=bool),
+        key=np.array([0, 42], dtype=np.uint32),
+        reduction_counter=1,
+        dual_solves=7,
+        exact_prices=2,
+    )
+    save_cg_state(path, state)
+    loaded = load_cg_state(path, n=10)
+    assert loaded is not None
+    np.testing.assert_array_equal(loaded.portfolio, state.portfolio)
+    np.testing.assert_array_equal(loaded.fixed, state.fixed)
+    assert loaded.dual_solves == 7 and loaded.exact_prices == 2
+    # wrong pool size ⇒ checkpoint ignored
+    assert load_cg_state(path, n=11) is None
+    clear_cg_state(path)
+    assert load_cg_state(path, n=10) is None
+    clear_cg_state(path)  # idempotent
+
+
+def test_completion_clears_checkpoint(small, tmp_path):
+    dense, space = small
+    path = tmp_path / "cg.npz"
+    dist = find_distribution_leximin(dense, space, checkpoint_path=str(path))
+    assert not path.exists(), "checkpoint must be removed on success"
+    assert abs(dist.allocation.sum() - dense.k) < 1e-3
+
+
+def test_resume_from_mid_state(small, tmp_path):
+    dense, space = small
+    n = dense.n
+    # reference run, no checkpointing
+    ref = find_distribution_leximin(dense, space)
+
+    # craft a mid-run state: full portfolio, half the agents' leximin values
+    # already fixed (a tranche boundary), and resume from it
+    fixed = ref.fixed_probabilities.copy()
+    unfix = np.argsort(fixed)[n // 2:]
+    fixed[unfix] = -1.0
+    path = tmp_path / "cg.npz"
+    from citizensassemblies_tpu.utils.config import default_config
+    fp = problem_fingerprint(dense, default_config())
+    save_cg_state(path, CGState(
+        portfolio=ref.committees,
+        fixed=fixed,
+        covered=ref.covered,
+        key=np.array([0, 123], dtype=np.uint32),
+        fingerprint=fp,
+    ))
+    log = RunLog(echo=False)
+    dist = find_distribution_leximin(dense, space, checkpoint_path=str(path), log=log)
+    assert any("Resumed checkpoint" in line for line in log.lines)
+    assert not path.exists()
+    # resumed run must reproduce the leximin allocation
+    np.testing.assert_allclose(dist.allocation, ref.allocation, atol=2e-2)
+    assert abs(dist.allocation.min() - ref.allocation.min()) < 1e-2
+
+
+def test_foreign_checkpoint_ignored(small, tmp_path):
+    """A checkpoint written for a different problem (config/households/quotas)
+    must not be resumed — it starts fresh instead of producing wrong output."""
+    dense, space = small
+    ref = find_distribution_leximin(dense, space)
+    path = tmp_path / "cg.npz"
+    save_cg_state(path, CGState(
+        portfolio=ref.committees,
+        fixed=np.full(dense.n, -1.0),
+        covered=ref.covered,
+        key=np.array([0, 1], dtype=np.uint32),
+        fingerprint="deadbeef-some-other-problem",
+    ))
+    log = RunLog(echo=False)
+    dist = find_distribution_leximin(dense, space, checkpoint_path=str(path), log=log)
+    assert not any("Resumed checkpoint" in line for line in log.lines)
+    np.testing.assert_allclose(dist.allocation, ref.allocation, atol=2e-2)
+
+
+def test_corrupt_checkpoint_ignored(small, tmp_path):
+    dense, space = small
+    path = tmp_path / "cg.npz"
+    path.write_bytes(b"not an npz at all")
+    assert load_cg_state(path, dense.n) is None
+    dist = find_distribution_leximin(dense, space, checkpoint_path=str(path))
+    assert abs(dist.allocation.sum() - dense.k) < 1e-3

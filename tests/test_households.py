@@ -1,0 +1,59 @@
+"""Same-address / household constraints (reference ``legacy.py:78-99``,
+``leximin.py:211-221,359-362``): at most one member per household, enforced by
+LEGACY's eviction and LEXIMIN's oracle constraints."""
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import cross_product_instance
+from citizensassemblies_tpu.core.instance import Instance, compute_households, featurize
+from citizensassemblies_tpu.models.legacy import sample_feasible_panels
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.utils.config import default_config
+
+
+@pytest.fixture(scope="module")
+def house_instance():
+    # n=20, k=4, one loose category; agents paired into 10 households of 2
+    inst = cross_product_instance(
+        categories=["g"], features=[["a", "b"]], quotas=[[(0, 4), (0, 4)]],
+        counts=[10, 10], k=4, name="house_4",
+    )
+    inst.columns_data = [
+        {"address1": f"{i // 2} Main St", "zip": "90210"} for i in range(20)
+    ]
+    return inst
+
+
+def test_compute_households_groups_by_address(house_instance):
+    h = compute_households(house_instance, ["address1", "zip"])
+    assert h.shape == (20,)
+    assert len(np.unique(h)) == 10
+    assert h[0] == h[1] and h[0] != h[2]
+
+
+def test_compute_households_requires_columns():
+    inst = Instance(k=2, categories={"g": {"a": (0, 2)}}, agents=[{"g": "a"}] * 4,
+                    name="x_2")
+    with pytest.raises(ValueError, match="columns_data"):
+        compute_households(inst, ["address1", "zip"])
+
+
+def test_legacy_respects_households(house_instance):
+    dense, _ = featurize(house_instance)
+    h = compute_households(house_instance, ["address1", "zip"])
+    cfg = default_config().replace(mc_batch=512)
+    panels, _ = sample_feasible_panels(dense, 400, seed=0, cfg=cfg, households=h)
+    for row in panels:
+        assert len(set(h[row])) == len(row), f"household collision in panel {row}"
+
+
+def test_leximin_respects_households(house_instance):
+    dense, space = featurize(house_instance)
+    h = compute_households(house_instance, ["address1", "zip"])
+    dist = find_distribution_leximin(dense, space, households=h)
+    for panel in dist.support():
+        assert len(set(h[list(panel)])) == len(panel)
+    assert abs(dist.allocation.sum() - dense.k) < 1e-3
+    # with 10 households and k=4, leximin can still cover everyone
+    assert dist.allocation.min() > 0
