@@ -247,10 +247,22 @@ def find_distribution_leximin(
                     fingerprint=ckpt_fp,
                 ),
             )
+        dual_warm = None
         while True:
             P = portfolio.matrix()
             with log.timer("dual_lp"):
-                sol = solve_dual_lp(P, fixed)
+                if cfg.backend == "jax":
+                    # device PDHG, warm-started from the previous inner round
+                    # (the portfolio only gains rows, so the old optimum is
+                    # nearly feasible); HiGHS only on non-convergence
+                    from citizensassemblies_tpu.solvers.lp_pdhg import solve_dual_lp_pdhg
+
+                    sol, dual_warm = solve_dual_lp_pdhg(P, fixed, cfg=cfg, warm=dual_warm)
+                    if not sol.ok:
+                        sol = solve_dual_lp(P, fixed)
+                        dual_warm = None
+                else:
+                    sol = solve_dual_lp(P, fixed)
             dual_solves += 1
             if not sol.ok:
                 # numerically infeasible: shave all fixed probabilities a bit
@@ -277,9 +289,10 @@ def find_distribution_leximin(
             if new:
                 continue
 
-            # certification: exact pricing oracle (leximin.py:420-431)
+            # certification: exact pricing oracle seeded at the dual cap —
+            # "does any committee beat ŷ + EPS?" (leximin.py:420-431)
             with log.timer("exact_oracle"):
-                panel, value = oracle.maximize(sol.y)
+                panel, value = oracle.certify(sol.y, sol.yhat + cfg.eps)
             exact_prices += 1
             log.emit(
                 f"Maximin is at most {sol.objective - sol.yhat + value:.2%}, can do "
@@ -322,6 +335,10 @@ def find_distribution_leximin(
             from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
 
             probs, eps_dev = solve_final_primal_l2(P, fixed)
+        elif cfg.backend == "jax":
+            from citizensassemblies_tpu.solvers.lp_pdhg import solve_final_primal_lp_pdhg
+
+            probs, eps_dev = solve_final_primal_lp_pdhg(P, fixed, cfg=cfg)
         else:
             probs, eps_dev = solve_final_primal_lp(P, fixed)
     probs = np.clip(probs, 0.0, 1.0)

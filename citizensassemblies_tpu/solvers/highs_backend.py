@@ -90,6 +90,45 @@ class HighsCommitteeOracle:
         self._lb = np.concatenate(lbs)
         self._ub = np.concatenate(ubs)
         self._integrality = np.ones(self.n)
+        self._reduction = None  # lazy TypeReduction for the native oracle
+        self._dense = dense
+
+    def _native_maximize(self, weights: np.ndarray, incumbent: float = -1e300,
+                         max_nodes: int = 500_000):
+        """Try the native exact oracle; None means 'use the MILP path'.
+
+        The node budget bounds the downside of a hard search to well under a
+        second — the MILP fallback then decides."""
+        from citizensassemblies_tpu.solvers import native_oracle
+
+        if not native_oracle.native_available():
+            return None
+        if self._reduction is None:
+            self._reduction = native_oracle.TypeReduction(self._dense)
+        return native_oracle.price_exact(
+            self._reduction, weights, incumbent=incumbent, max_nodes=max_nodes
+        )
+
+    def certify(self, weights: np.ndarray, floor: float):
+        """Decide whether any feasible committee has value > ``floor``; if
+        yes, return one (``(committee, value)``), else ``(None, floor)``.
+
+        This is the column-generation termination test
+        (``leximin.py:429-431``): seeded with ``floor`` as the incumbent, the
+        native branch-and-bound usually certifies 'no violating committee'
+        from the root bound alone — orders of magnitude less work than an
+        unseeded exact maximization.
+        """
+        if self.households is None:
+            res = self._native_maximize(weights, incumbent=float(floor))
+            if res is not None:
+                committee, value = res
+                return (None, float(floor)) if committee is None else (committee, value)
+        # native unavailable or aborted on its node budget: go straight to the
+        # MILP (re-running the native search unseeded would only repeat the
+        # work that just hit the limit)
+        committee, value = self._milp_maximize(weights)
+        return (None, float(floor)) if value <= floor else (committee, value)
 
     def maximize(
         self, weights: np.ndarray, forced: Sequence[int] = ()
@@ -98,9 +137,22 @@ class HighsCommitteeOracle:
         agents are constrained into the committee (the ``ensure_inclusion``
         capability, ``leximin.py:104-107,129-133``).
 
-        Raises :class:`SelectionError` if no feasible committee exists under
-        the constraints.
+        Dispatches to the native type-reduced branch-and-bound
+        (``native/bb_price.cpp``) when the problem has no household or
+        forced-inclusion side constraints (those break type
+        interchangeability); falls back to the HiGHS MILP otherwise or when
+        the native search aborts. Raises :class:`SelectionError` if no
+        feasible committee exists under the constraints.
         """
+        if self.households is None and not forced:
+            res = self._native_maximize(weights)
+            if res is not None:
+                return res
+        return self._milp_maximize(weights, forced)
+
+    def _milp_maximize(
+        self, weights: np.ndarray, forced: Sequence[int] = ()
+    ) -> Tuple[Tuple[int, ...], float]:
         lo = np.zeros(self.n)
         for i in forced:
             lo[i] = 1.0

@@ -1,0 +1,168 @@
+"""Native exact pricing oracle: ctypes bindings for ``native/bb_price.cpp``.
+
+The host-side runtime component of the solver layer (the role Gurobi's C
+libraries play for the reference, ``leximin.py:16-17``): an exact
+branch-and-bound over agent *types* (agents with identical feature vectors are
+interchangeable up to weights, so the n-variable pricing ILP collapses to a
+#types-variable integer program — see the header comment of
+``native/bb_price.cpp`` for the math).
+
+The shared library is compiled on first use with the system ``g++`` and cached
+next to the source; every call certifies optimality (status 0) or reports a
+node-limit abort, in which case callers fall back to the scipy/HiGHS MILP.
+Households and forced-inclusion constraints break type interchangeability, so
+those calls always use the HiGHS path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import DenseInstance
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "bb_price.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "build", "libbb_price.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the shared library; None if unavailable."""
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.bb_price.restype = ctypes.c_int
+            lib.bb_price.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),  # type_feature
+                ctypes.POINTER(ctypes.c_int32),  # msize
+                ctypes.POINTER(ctypes.c_double),  # prefix
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),  # lo
+                ctypes.POINTER(ctypes.c_int32),  # hi
+                ctypes.c_int, ctypes.c_double, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),  # out_counts
+                ctypes.POINTER(ctypes.c_double),  # out_value
+                ctypes.POINTER(ctypes.c_int64),  # out_nodes
+            ]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class TypeReduction:
+    """Group agents by identical feature rows and precompute the per-type
+    structure the native search consumes. Reused across pricing calls — only
+    the weights change per call."""
+
+    def __init__(self, dense: DenseInstance):
+        A = np.asarray(dense.A, dtype=np.int8)
+        self.n, self.F = A.shape
+        self.k = int(dense.k)
+        self.qmin = np.asarray(dense.qmin, dtype=np.int32)
+        self.qmax = np.asarray(dense.qmax, dtype=np.int32)
+        # category structure: columns of A are grouped by category via the
+        # one-hot property (each agent has exactly one feature per category);
+        # recover per-agent feature index per category from the dense rows
+        _, type_id, counts = np.unique(
+            A, axis=0, return_inverse=True, return_counts=True
+        )
+        self.type_id = type_id  # [n] agent -> type
+        self.T = len(counts)
+        self.msize = counts.astype(np.int32)
+        self.members = [np.nonzero(type_id == t)[0] for t in range(self.T)]
+        # [T, n_cats] global feature index per category, from any member's row
+        reps = np.array([m[0] for m in self.members])
+        rows = A[reps]  # [T, F] one-hot per category block
+        feats = [np.nonzero(r)[0].astype(np.int32) for r in rows]
+        n_cats = len(feats[0]) if feats else 0
+        assert all(len(f) == n_cats for f in feats), "rows must be one-hot per category"
+        self.n_cats = n_cats
+        self.type_feature = np.stack(feats, axis=0) if n_cats else np.zeros((self.T, 0), np.int32)
+        self.maxm = int(self.msize.max()) if self.T else 0
+
+    def prepare(self, weights: np.ndarray):
+        """Sort each type's members by weight (desc) and build prefix sums."""
+        w = np.asarray(weights, dtype=np.float64)
+        order = []  # per type: member ids sorted by weight desc
+        prefix = np.zeros((self.T, self.maxm + 1), dtype=np.float64)
+        for t, mem in enumerate(self.members):
+            o = mem[np.argsort(-w[mem], kind="stable")]
+            order.append(o)
+            prefix[t, 1 : len(o) + 1] = np.cumsum(w[o])
+        return order, prefix
+
+
+def price_exact(
+    reduction: TypeReduction,
+    weights: np.ndarray,
+    incumbent: float = -1e300,
+    max_nodes: int = 20_000_000,
+) -> Optional[Tuple[Optional[Tuple[int, ...]], float]]:
+    """Certified-exact ``max Σ w_i x_i`` over feasible committees.
+
+    Returns ``(committee, value)``; ``committee is None`` means the incumbent
+    value passed in is certified optimal (no feasible committee beats it).
+    Returns ``None`` (caller should fall back to HiGHS) when the native
+    library is unavailable, the node limit was hit, or no feasible committee
+    exists under an unseeded search.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    order, prefix = reduction.prepare(weights)
+    tf = np.ascontiguousarray(reduction.type_feature, dtype=np.int32)
+    msize = np.ascontiguousarray(reduction.msize, dtype=np.int32)
+    prefix_c = np.ascontiguousarray(prefix, dtype=np.float64)
+    lo = np.ascontiguousarray(reduction.qmin, dtype=np.int32)
+    hi = np.ascontiguousarray(reduction.qmax, dtype=np.int32)
+    out_counts = np.zeros(reduction.T, dtype=np.int32)
+    out_value = ctypes.c_double(0.0)
+    out_nodes = ctypes.c_int64(0)
+
+    def p(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    status = lib.bb_price(
+        reduction.T, reduction.n_cats, reduction.F,
+        p(tf, ctypes.c_int32), p(msize, ctypes.c_int32), p(prefix_c, ctypes.c_double),
+        reduction.maxm, p(lo, ctypes.c_int32), p(hi, ctypes.c_int32),
+        reduction.k, float(incumbent), int(max_nodes),
+        p(out_counts, ctypes.c_int32), ctypes.byref(out_value), ctypes.byref(out_nodes),
+    )
+    if status == 0:
+        if out_counts[0] == -1 and np.all(out_counts == -1):
+            return None, float(out_value.value)  # incumbent certified optimal
+        members = []
+        for t in range(reduction.T):
+            c = int(out_counts[t])
+            if c:
+                members.extend(order[t][:c].tolist())
+        committee = tuple(sorted(int(i) for i in members))
+        return committee, float(out_value.value)
+    return None  # status 1 (infeasible unseeded), 2 (node limit), 3 (bad args)

@@ -66,8 +66,10 @@ class Config:
     xmin_dedup_attempts_factor: int = 3
 
     # --- PDHG LP solver -------------------------------------------------------
+    #: KKT tolerance for the device PDHG LP solver — 1e-6 is near the float32
+    #: noise floor and two orders below the EPS=5e-4 fixing tolerance.
     pdhg_max_iters: int = 100_000
-    pdhg_tol: float = 1e-7
+    pdhg_tol: float = 1e-6
     pdhg_check_every: int = 64
 
     # --- backends -------------------------------------------------------------

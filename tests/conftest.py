@@ -11,8 +11,17 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 # The session environment may pin JAX_PLATFORMS to a TPU tunnel (e.g. "axon");
-# tests must run on the virtual CPU mesh, so override unconditionally.
+# tests must run on the virtual CPU mesh, so override unconditionally. The
+# tunnel plugin's sitecustomize hook re-forces its own platform via
+# ``jax.config.update`` at interpreter start, so the env var alone is not
+# enough — reset the *config* too, before any backend is materialized
+# (backend construction is lazy, so this prevents the tunnel client from ever
+# being created; with a hung tunnel that client blocks forever).
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 from pathlib import Path
 

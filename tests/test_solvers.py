@@ -1,0 +1,153 @@
+"""Solver-layer tests: the native type-reduced branch-and-bound oracle
+(``native/bb_price.cpp``) against the scipy/HiGHS MILP, and the device PDHG
+LP solver (``solvers/lp_pdhg.py``) against the HiGHS LPs — the two exact
+backends must agree because LEXIMIN's optimality certificate rests on them
+(reference dual-gap test, ``leximin.py:429-431``)."""
+
+import numpy as np
+import pytest
+from scipy.optimize import Bounds, LinearConstraint, milp
+
+from citizensassemblies_tpu.core.generator import random_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.solvers.highs_backend import (
+    HighsCommitteeOracle,
+    solve_dual_lp,
+    solve_final_primal_lp,
+)
+from citizensassemblies_tpu.solvers.lp_pdhg import (
+    solve_dual_lp_pdhg,
+    solve_final_primal_lp_pdhg,
+)
+from citizensassemblies_tpu.solvers.native_oracle import (
+    TypeReduction,
+    native_available,
+    price_exact,
+)
+from citizensassemblies_tpu.utils.config import Config
+
+
+needs_native = pytest.mark.skipif(not native_available(), reason="g++/native lib unavailable")
+
+
+def _milp_optimum(dense, w):
+    """Reference optimum straight from scipy's HiGHS MILP (no native path)."""
+    oracle = HighsCommitteeOracle(dense)
+    res = milp(
+        c=-w,
+        constraints=LinearConstraint(oracle._mat, oracle._lb, oracle._ub),
+        integrality=np.ones(dense.n),
+        bounds=Bounds(np.zeros(dense.n), np.ones(dense.n)),
+    )
+    if res.status != 0 or res.x is None:
+        return None
+    return float(w @ (res.x > 0.5))
+
+
+@needs_native
+def test_native_oracle_matches_milp_fuzz():
+    rng = np.random.default_rng(7)
+    for trial in range(12):
+        n = int(rng.integers(20, 90))
+        k = int(rng.integers(3, max(4, n // 4)))
+        inst = random_instance(
+            n=n, k=k,
+            n_categories=int(rng.integers(1, 4)),
+            features_per_category=int(rng.integers(2, 4)),
+            seed=trial,
+        )
+        dense, _ = featurize(inst)
+        w = rng.normal(size=n)
+        res = price_exact(TypeReduction(dense), w)
+        ref = _milp_optimum(dense, w)
+        if res is None:
+            assert ref is None, f"native gave up but MILP solved (trial {trial})"
+            continue
+        committee, value = res
+        assert ref is not None
+        assert abs(value - ref) < 1e-6, f"trial {trial}: native {value} vs milp {ref}"
+        # the returned committee must itself be feasible and consistent
+        x = np.zeros(n)
+        x[list(committee)] = 1.0
+        counts = np.asarray(dense.A).T @ x
+        assert len(committee) == k
+        assert (counts >= np.asarray(dense.qmin) - 1e-9).all()
+        assert (counts <= np.asarray(dense.qmax) + 1e-9).all()
+        assert abs(w @ x - value) < 1e-9
+
+
+@needs_native
+def test_native_certify_floor_semantics():
+    inst = random_instance(n=60, k=10, n_categories=2, features_per_category=3, seed=3)
+    dense, _ = featurize(inst)
+    rng = np.random.default_rng(0)
+    w = rng.exponential(size=dense.n)
+    opt = _milp_optimum(dense, w)
+    red = TypeReduction(dense)
+    # floor above the optimum: certified, no committee returned
+    committee, value = price_exact(red, w, incumbent=opt + 1e-6)
+    assert committee is None and value == pytest.approx(opt + 1e-6)
+    # floor below the optimum: must find a strictly better committee
+    committee, value = price_exact(red, w, incumbent=opt - 1e-3)
+    assert committee is not None
+    assert value == pytest.approx(opt, abs=1e-6)
+    # oracle.certify wires the same semantics with MILP fallback
+    oracle = HighsCommitteeOracle(dense)
+    c2, v2 = oracle.certify(w, opt + 1e-6)
+    assert c2 is None
+    c3, v3 = oracle.certify(w, opt - 1e-3)
+    assert c3 is not None and v3 == pytest.approx(opt, abs=1e-6)
+
+
+def _random_portfolio(rng, n=40, C=25, k=8):
+    P = np.zeros((C, n))
+    for r in range(C):
+        P[r, rng.choice(n, k, replace=False)] = 1.0
+    return P
+
+
+def test_pdhg_dual_lp_matches_highs():
+    rng = np.random.default_rng(5)
+    for trial in range(3):
+        P = _random_portfolio(rng)
+        n = P.shape[1]
+        fixed = np.full(n, -1.0)
+        # fix only agents that appear in some committee (as in the real
+        # algorithm) — otherwise the dual LP is unbounded
+        covered = np.nonzero(P.any(axis=0))[0]
+        chosen = rng.choice(covered, 8, replace=False)
+        fixed[chosen] = rng.uniform(0.05, 0.3, 8)
+        ref = solve_dual_lp(P, fixed)
+        got, warm = solve_dual_lp_pdhg(P, fixed)
+        assert ref.ok and got.ok
+        assert got.objective == pytest.approx(ref.objective, abs=5e-5)
+        assert got.yhat == pytest.approx(ref.yhat, abs=5e-5)
+        # warm-started re-solve with extra rows converges fast and agrees
+        P2 = np.vstack([P, _random_portfolio(rng, n=n, C=4)])
+        warm2 = (warm[0], np.concatenate([warm[1], np.zeros(4)]), warm[2])
+        ref2 = solve_dual_lp(P2, fixed)
+        got2, _ = solve_dual_lp_pdhg(P2, fixed, warm=warm2)
+        assert got2.ok
+        assert got2.objective == pytest.approx(ref2.objective, abs=5e-5)
+
+
+def test_pdhg_final_lp_matches_highs():
+    rng = np.random.default_rng(9)
+    P = _random_portfolio(rng)
+    target = rng.uniform(0.0, 0.25, P.shape[1])
+    p_ref, e_ref = solve_final_primal_lp(P, target)
+    p_got, e_got = solve_final_primal_lp_pdhg(P, target)
+    assert e_got == pytest.approx(e_ref, abs=1e-4)
+    assert np.sum(p_got) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_leximin_jax_backend_matches_hybrid():
+    """Full column generation with device PDHG LPs reproduces the HiGHS-LP
+    allocation (same math, different LP engine)."""
+    inst = random_instance(n=40, k=8, n_categories=2, features_per_category=2, seed=11)
+    dense, space = featurize(inst)
+    d_h = find_distribution_leximin(dense, space, cfg=Config(backend="hybrid"))
+    d_j = find_distribution_leximin(dense, space, cfg=Config(backend="jax"))
+    assert np.abs(d_h.allocation - d_j.allocation).max() < 1e-3
+    assert d_j.probabilities.sum() == pytest.approx(1.0, abs=1e-6)
