@@ -29,7 +29,12 @@ __version__ = "0.1.0"
 from citizensassemblies_tpu.core.instance import (  # noqa: F401
     DenseInstance,
     FeatureSpace,
+    InfeasibleQuotasError,
     Instance,
+    SelectionError,
+    compute_households,
     featurize,
     read_instance,
+    read_instance_dir,
 )
+from citizensassemblies_tpu.utils.config import Config, default_config  # noqa: F401

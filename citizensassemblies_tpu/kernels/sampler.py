@@ -1,0 +1,343 @@
+"""Fused Pallas TPU kernel for the greedy stratified panel sampler.
+
+The XLA path (``models/legacy.py::_sample_panels_kernel``) expresses one draw
+as a k-step ``lax.scan``; every step reads and writes the ``[B, n]`` alive
+mask (plus scores/noise buffers) through HBM, so the sampler is
+HBM-bandwidth-bound: ~k·4·B·n·4 bytes of traffic per batch. This kernel fuses
+the *entire* k-step draw: the grid tiles the chain batch, each program keeps
+its ``[block_b, n]`` alive mask and ``[block_b, F]`` selected counts resident
+in VMEM for all k steps, and only the final panels/ok flags leave the chip —
+a ~4k× HBM-traffic reduction. Every step is two MXU matmuls
+(``alive @ A`` remaining-counts, one-hot purge cascade) plus VPU argmax /
+masking, exactly the arithmetic of the scan path (same urgency-ratio
+semantics as the reference's ``legacy.py:124-157`` greedy, first-max
+tie-break, Gumbel-max member pick).
+
+Random bits come from a counter-based in-register hash RNG (two rounds of the
+murmur3 finalizer over a (seed, program, row, column, step)-unique counter,
+pure uint32 VPU arithmetic), so no noise tensors are streamed from HBM and
+the identical kernel runs under the CPU interpreter (the on-core
+``pltpu.prng_*`` primitives have no CPU lowering).
+
+The public wrapper pads (n, F, k) to lane/tile multiples and falls back to
+interpret mode off-TPU (used by the tests, which cross-check distribution
+statistics against the scan path).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _fmix32(h: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3 finalizer: a full-avalanche uint32 mix."""
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _uniform_bits(ctr: jnp.ndarray, salt: jnp.ndarray) -> jnp.ndarray:
+    """(0,1) floats from unique uint32 counters via a double murmur3 mix."""
+    h = _fmix32(_fmix32(ctr ^ salt) + jnp.uint32(0x9E3779B9))
+    return (h >> jnp.uint32(8)).astype(jnp.float32) * (1.0 / 16777216.0)
+
+
+def _sampler_kernel(
+    seed_ref,  # SMEM [1] int32
+    A_ref,  # VMEM [n_pad, F_pad] f32 (agent × feature one-hot, padded zeros)
+    AT_ref,  # VMEM [F_pad, n_pad] f32
+    qmin_ref,  # VMEM [1, F_pad] f32
+    qmax_ref,  # VMEM [1, F_pad] f32 (padding features: qmax = 0 → never eligible)
+    scores_ref,  # VMEM [block_b, n_pad] f32 member-pick bias (0 ⇒ uniform)
+    hh_ref,  # VMEM [1, n_pad] f32 household ids (distinct ⇒ no households)
+    panels_ref,  # VMEM out [block_b, k_pad] i32
+    ok_ref,  # VMEM out [block_b, 128] i32 (column 0 meaningful)
+    *,
+    k: int,
+    n: int,
+):
+    block_b, n_pad = scores_ref.shape
+    F_pad = A_ref.shape[1]
+    # injective uint32 counter per (global row, column): global_row·n_pad+col
+    # never collides while B_pad·n_pad < 2³²; the per-step variation goes into
+    # the salt instead, so (counter, salt) is unique per (row, col, step)
+    pid = pl.program_id(0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_b, n_pad), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (block_b, n_pad), 0)
+    ctr0 = (row + pid * block_b).astype(jnp.uint32) * jnp.uint32(n_pad) + col.astype(
+        jnp.uint32
+    )
+    salt = seed_ref[0].astype(jnp.uint32)
+    feat_col = jax.lax.broadcasted_iota(jnp.int32, (block_b, F_pad), 1)
+
+    alive0 = (col < n).astype(jnp.float32)
+    selected0 = jnp.zeros((block_b, F_pad), dtype=jnp.float32)
+    failed0 = jnp.zeros((block_b, 1), dtype=jnp.float32)
+
+    qmin = qmin_ref[0, :][None, :]
+    qmax = qmax_ref[0, :][None, :]
+    hh = hh_ref[0, :][None, :]
+    A = A_ref[:]
+    AT = AT_ref[:]
+    scores = scores_ref[:]
+
+    def step(j, carry):
+        alive, selected, failed = carry
+        # per-cell remaining counts: one MXU matmul (legacy.py:47-75 counters)
+        remaining = jnp.dot(alive, A, preferred_element_type=jnp.float32)
+        deficit = qmin - selected
+        # a cell that cannot reach its lower quota kills the draw
+        # (legacy.py:55-57,132-137)
+        starved = jnp.max(
+            jnp.where(deficit > remaining, 1.0, 0.0), axis=1, keepdims=True
+        )
+        eligible = (remaining > 0.5) & (qmax > 0.5)
+        ratio = jnp.where(eligible, deficit / jnp.maximum(remaining, 1.0), NEG_INF)
+        # first maximum wins, as in the reference's dict-iteration order
+        cell = jnp.argmax(ratio, axis=1)  # [block_b]
+        cell_oh = (feat_col == cell[:, None]).astype(jnp.float32)
+        # members of each chain's urgent cell, among its alive agents
+        members = alive * jnp.dot(cell_oh, AT, preferred_element_type=jnp.float32)
+        has_member = jnp.max(members, axis=1, keepdims=True)
+
+        # Gumbel-max member pick: uniform for scores≡0, softmax(scores) else
+        step_salt = salt ^ (jnp.uint32(j) * jnp.uint32(0x85EBCA77))
+        u = _uniform_bits(ctr0, step_salt)
+        gumbel = -jnp.log(-jnp.log(u + 1e-12) + 1e-12)
+        person = jnp.argmax(
+            jnp.where(members > 0.5, scores + gumbel, NEG_INF), axis=1
+        )
+        p_oh = (col == person[:, None]).astype(jnp.float32)
+        person_feats = jnp.dot(p_oh, A, preferred_element_type=jnp.float32)
+        selected = selected + person_feats
+
+        # purge cascade: cells of the pick that just hit their upper quota
+        # evict all their members (legacy.py:103-120,47-62) — one matmul
+        purged = jnp.where(
+            (jnp.abs(selected - qmax) < 0.5) & (person_feats > 0.5), 1.0, 0.0
+        )
+        kill = jnp.dot(purged, AT, preferred_element_type=jnp.float32)
+        # evict the pick's whole household (distinct ids ⇒ just the pick)
+        hh_person = jnp.sum(p_oh * hh, axis=1, keepdims=True)
+        alive = alive * jnp.where(kill > 0.5, 0.0, 1.0)
+        alive = alive * jnp.where(jnp.abs(hh - hh_person) < 0.5, 0.0, 1.0)
+
+        failed = jnp.maximum(failed, jnp.maximum(starved, 1.0 - has_member))
+        panels_ref[:, pl.ds(j, 1)] = person[:, None].astype(jnp.int32)
+        return alive, selected, failed
+
+    alive, selected, failed = jax.lax.fori_loop(
+        0, k, step, (alive0, selected0, failed0)
+    )
+    # final lower-quota audit (check_min_cats, legacy.py:160-168)
+    shortfall = jnp.max(
+        jnp.where(selected < qmin, 1.0, 0.0), axis=1, keepdims=True
+    )
+    ok = 1.0 - jnp.maximum(failed, shortfall)
+    ok_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), ok_ref.shape)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("B", "block_b", "k", "n", "k_pad", "interpret"),
+)
+def _pallas_sample(
+    A_pad,
+    AT_pad,
+    qmin_pad,
+    qmax_pad,
+    scores,
+    hh,
+    seed,
+    B: int,
+    block_b: int,
+    k: int,
+    n: int,
+    k_pad: int,
+    interpret: bool,
+):
+    n_pad, F_pad = A_pad.shape
+    grid = (B // block_b,)
+    panels, ok = pl.pallas_call(
+        partial(_sampler_kernel, k=k, n=n),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_pad, F_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((F_pad, n_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, F_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, F_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, n_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b, k_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, k_pad), jnp.int32),
+            jax.ShapeDtypeStruct((B, 128), jnp.int32),
+        ],
+        interpret=interpret,
+    )(seed, A_pad, AT_pad, qmin_pad, qmax_pad, scores, hh)
+    return panels[:, :k], ok[:, 0].astype(bool)
+
+
+#: VMEM budget for the per-program working set (bytes). Real VMEM is ~16 MB
+#: per core; leave headroom for the compiler's own buffers.
+_VMEM_BUDGET = 8 * 2**20
+
+#: small LRU of padded device constants keyed by the DenseInstance identity —
+#: rejection sampling and column generation call the sampler in a hot loop
+#: with the same instance, and re-padding/re-uploading A/Aᵀ per call would be
+#: pure host-side waste. Entries hold strong references (pins ≤ CAP instances;
+#: acceptable for this workload shape, where a process analyzes few pools).
+from collections import OrderedDict
+
+_PAD_CACHE: "OrderedDict[int, tuple]" = OrderedDict()
+_PAD_CACHE_CAP = 4
+
+
+def _pads(dense) -> Tuple[int, int, int]:
+    """(n_pad, F_pad, k_pad) — the single owner of the kernel's padding rule."""
+    return (
+        _round_up(max(dense.n, 128), 128),
+        _round_up(max(dense.n_features, 128), 128),
+        _round_up(dense.k, 128),
+    )
+
+
+def pick_block_b(n_pad: int, F_pad: int, k_pad: int = 128, max_block: int = 256) -> int:
+    """Largest chain-block (multiple of 8, ≤ max_block) whose working set fits
+    the VMEM budget: ~5 [block_b, n_pad] f32 buffers (alive, members, one-hot,
+    noise, scores), ~8 [block_b, F_pad] buffers (selected, remaining, deficit,
+    ratio, eligibility, cell one-hot, person_feats, purged), the [block_b,
+    k_pad] panel output, plus the shared A/Aᵀ tiles. Returns 0 if even
+    block_b = 8 does not fit (caller should use the HBM-streaming scan path
+    instead)."""
+    shared = 2 * n_pad * F_pad * 4
+    per_row = (5 * n_pad + 8 * F_pad + k_pad) * 4
+    avail = _VMEM_BUDGET - shared
+    if avail <= 0:
+        return 0
+    block = min(max_block, (avail // per_row) // 8 * 8)
+    return int(block) if block >= 8 else 0
+
+
+def block_for_dense(dense, max_block: int = 256) -> int:
+    """VMEM-fitted chain block for ``dense`` (0 ⇒ the fused kernel does not
+    fit; dispatchers should fall back to the scan sampler)."""
+    n_pad, F_pad, k_pad = _pads(dense)
+    return pick_block_b(n_pad, F_pad, k_pad, max_block=max_block)
+
+
+def _padded_constants(dense):
+    """Padded A/Aᵀ/qmin/qmax device arrays for ``dense`` (LRU-cached)."""
+    cache_key = id(dense)
+    hit = _PAD_CACHE.get(cache_key)
+    if hit is not None and hit[0] is dense:
+        _PAD_CACHE.move_to_end(cache_key)
+        return hit[1]
+    n, F = dense.n, dense.n_features
+    n_pad, F_pad, _ = _pads(dense)
+    A = np.zeros((n_pad, F_pad), dtype=np.float32)
+    A[:n, :F] = np.asarray(dense.A, dtype=np.float32)
+    qmin = np.zeros((1, F_pad), dtype=np.float32)
+    qmin[0, :F] = np.asarray(dense.qmin, dtype=np.float32)
+    qmax = np.zeros((1, F_pad), dtype=np.float32)
+    qmax[0, :F] = np.asarray(dense.qmax, dtype=np.float32)
+    out = (jnp.asarray(A), jnp.asarray(A.T.copy()), jnp.asarray(qmin), jnp.asarray(qmax))
+    while len(_PAD_CACHE) >= _PAD_CACHE_CAP:
+        _PAD_CACHE.popitem(last=False)
+    _PAD_CACHE[cache_key] = (dense, out)
+    return out
+
+
+def sample_panels_pallas(
+    dense,
+    key,
+    B: int,
+    scores: Optional[jnp.ndarray] = None,
+    households: Optional[np.ndarray] = None,
+    block_b: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Draw ``B`` panels with the fused kernel; returns (panels[B,k], ok[B]).
+
+    Drop-in equivalent of ``models.legacy.sample_panels_batch`` (same
+    feasibility semantics; per-seed streams differ — both are rejection
+    samplers of the same greedy distribution). ``interpret=None`` auto-selects
+    interpret mode off-TPU so tests run on CPU. ``block_b=None`` sizes the
+    chain block to the VMEM budget; raises ValueError if no block fits (use
+    the scan path for such instances — ``sample_panels_batch`` does this
+    automatically).
+    """
+    n, F, k = dense.n, dense.n_features, dense.k
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n_pad, F_pad, k_pad = _pads(dense)
+    if block_b is None:
+        block_b = pick_block_b(n_pad, F_pad, k_pad)
+        if block_b == 0:
+            raise ValueError(
+                f"instance too large for the fused sampler's VMEM budget "
+                f"(n_pad={n_pad}, F_pad={F_pad}); use the scan sampler"
+            )
+    B_pad = _round_up(B, block_b)
+
+    A_d, AT_d, qmin_d, qmax_d = _padded_constants(dense)
+    if scores is None:
+        sc = jnp.zeros((B_pad, n_pad), dtype=jnp.float32)
+    else:
+        scores = jnp.asarray(scores, dtype=jnp.float32)
+        if scores.ndim == 1:
+            scores = scores[None, :]
+        if scores.shape[1] != n or scores.shape[0] not in (1, B):
+            raise ValueError(
+                f"scores must have shape (n,), (1, n) or (B, n) = ({B}, {n}); "
+                f"got {scores.shape}"
+            )
+        scores = jnp.broadcast_to(scores, (B, n))
+        sc = jnp.zeros((B_pad, n_pad), dtype=jnp.float32).at[:B, :n].set(scores)
+    if households is None:
+        hh = np.arange(n_pad, dtype=np.float32)[None, :]
+    else:
+        hh = np.full((1, n_pad), -1.0, dtype=np.float32)
+        hh[0, :n] = np.asarray(households, dtype=np.float32)
+        # padding agents get unique ids so they never alias a real household
+        hh[0, n:] = np.arange(n_pad - n, dtype=np.float32) + float(np.max(households)) + 1.0
+    seed = jnp.asarray(
+        jax.random.randint(key, (1,), 0, np.iinfo(np.int32).max), dtype=jnp.int32
+    )
+    panels, ok = _pallas_sample(
+        A_d,
+        AT_d,
+        qmin_d,
+        qmax_d,
+        sc,
+        jnp.asarray(hh),
+        seed,
+        B=B_pad,
+        block_b=block_b,
+        k=k,
+        n=n,
+        k_pad=k_pad,
+        interpret=bool(interpret),
+    )
+    return panels[:B], ok[:B]

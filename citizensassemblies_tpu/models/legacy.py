@@ -153,8 +153,31 @@ def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None, househ
     return panels, ~failed
 
 
-def sample_panels_batch(dense: DenseInstance, key, batch: int, scores=None, households=None):
-    """Public jitted batch draw; returns (panels[B,k], ok[B]) as device arrays."""
+def sample_panels_batch(
+    dense: DenseInstance, key, batch: int, scores=None, households=None,
+    sampler: str = "auto",
+):
+    """Public batch draw; returns (panels[B,k], ok[B]) as device arrays.
+
+    ``sampler``: "scan" uses the lax.scan kernel (every step streams the
+    [B, n] masks through HBM); "pallas" uses the fused VMEM-resident kernel
+    (``kernels/sampler.py``); "auto" picks pallas on TPU, scan elsewhere.
+    Both draw from the same greedy distribution (cross-checked statistically
+    in ``tests/test_kernels.py``); per-seed streams differ.
+    """
+    if sampler == "auto":
+        if jax.default_backend() == "tpu":
+            from citizensassemblies_tpu.kernels.sampler import block_for_dense
+
+            sampler = "pallas" if block_for_dense(dense) > 0 else "scan"
+        else:
+            sampler = "scan"
+    if sampler == "pallas":
+        from citizensassemblies_tpu.kernels.sampler import sample_panels_pallas
+
+        return sample_panels_pallas(dense, key, batch, scores=scores, households=households)
+    if sampler != "scan":
+        raise ValueError(f"unknown sampler {sampler!r}: expected 'auto', 'pallas' or 'scan'")
     return _sample_panels_kernel(dense, key, batch, scores, households)
 
 
@@ -185,7 +208,7 @@ def sample_feasible_panels(
     draws = 0
     while total < num:
         key, sub = jax.random.split(key)
-        panels, ok = _sample_panels_kernel(dense, sub, B, households=households)
+        panels, ok = sample_panels_batch(dense, sub, B, households=households)
         ok_np = np.asarray(ok)
         draws += B
         good = np.asarray(panels)[ok_np]

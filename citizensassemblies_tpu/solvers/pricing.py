@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.core.instance import DenseInstance
-from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+from citizensassemblies_tpu.models.legacy import sample_panels_batch
 from citizensassemblies_tpu.utils.config import Config, default_config
 
 
@@ -56,7 +56,7 @@ def stochastic_price(
     B = batch or cfg.pricing_batch
     w = jnp.asarray(weights, dtype=jnp.float32)
     scores = _pricing_scores(w, B)
-    panels, ok = _sample_panels_kernel(dense, key, B, scores, households)
+    panels, ok = sample_panels_batch(dense, key, B, scores=scores, households=households)
     panels = np.sort(np.asarray(panels), axis=1)
     values = np.asarray(weights, dtype=np.float64)[panels].sum(axis=1)
     return panels, values, np.asarray(ok)
