@@ -55,7 +55,10 @@ def _fmix32(h: jnp.ndarray) -> jnp.ndarray:
 def _uniform_bits(ctr: jnp.ndarray, salt: jnp.ndarray) -> jnp.ndarray:
     """(0,1) floats from unique uint32 counters via a double murmur3 mix."""
     h = _fmix32(_fmix32(ctr ^ salt) + jnp.uint32(0x9E3779B9))
-    return (h >> jnp.uint32(8)).astype(jnp.float32) * (1.0 / 16777216.0)
+    # Mosaic has no uint32→f32 cast; h>>8 < 2^24 so a value-preserving
+    # bitcast through int32 reaches the supported int32→f32 path.
+    mantissa = jax.lax.bitcast_convert_type(h >> jnp.uint32(8), jnp.int32)
+    return mantissa.astype(jnp.float32) * (1.0 / 16777216.0)
 
 
 def _sampler_kernel(
@@ -89,6 +92,9 @@ def _sampler_kernel(
     alive0 = (col < n).astype(jnp.float32)
     selected0 = jnp.zeros((block_b, F_pad), dtype=jnp.float32)
     failed0 = jnp.zeros((block_b, 1), dtype=jnp.float32)
+    k_pad = panels_ref.shape[1]
+    panel0 = jnp.zeros((block_b, k_pad), dtype=jnp.int32)
+    kcol = jax.lax.broadcasted_iota(jnp.int32, (block_b, k_pad), 1)
 
     qmin = qmin_ref[0, :][None, :]
     qmax = qmax_ref[0, :][None, :]
@@ -98,7 +104,7 @@ def _sampler_kernel(
     scores = scores_ref[:]
 
     def step(j, carry):
-        alive, selected, failed = carry
+        alive, selected, failed, panel = carry
         # per-cell remaining counts: one MXU matmul (legacy.py:47-75 counters)
         remaining = jnp.dot(alive, A, preferred_element_type=jnp.float32)
         deficit = qmin - selected
@@ -139,12 +145,15 @@ def _sampler_kernel(
         alive = alive * jnp.where(jnp.abs(hh - hh_person) < 0.5, 0.0, 1.0)
 
         failed = jnp.maximum(failed, jnp.maximum(starved, 1.0 - has_member))
-        panels_ref[:, pl.ds(j, 1)] = person[:, None].astype(jnp.int32)
-        return alive, selected, failed
+        # masked select into the carried panel buffer: a dynamic-offset
+        # column store cannot be proven 128-aligned by Mosaic
+        panel = jnp.where(kcol == j, person[:, None].astype(jnp.int32), panel)
+        return alive, selected, failed, panel
 
-    alive, selected, failed = jax.lax.fori_loop(
-        0, k, step, (alive0, selected0, failed0)
+    alive, selected, failed, panel = jax.lax.fori_loop(
+        0, k, step, (alive0, selected0, failed0, panel0)
     )
+    panels_ref[:] = panel
     # final lower-quota audit (check_min_cats, legacy.py:160-168)
     shortfall = jnp.max(
         jnp.where(selected < qmin, 1.0, 0.0), axis=1, keepdims=True
