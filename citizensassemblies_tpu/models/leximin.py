@@ -162,6 +162,88 @@ def _seed_portfolio(
     return covered
 
 
+def _enumerated_leximin(
+    dense: DenseInstance,
+    cfg: Config,
+    log: RunLog,
+    final_stage: str,
+) -> Optional[Distribution]:
+    """Exact leximin via full type-space enumeration, when the instance has
+    few distinct agent types (see ``solvers/compositions.py``).
+
+    Returns None when the instance is not enumerable within budget, in which
+    case the caller falls back to column generation. The headline reference
+    instances all qualify: ``example_large_200`` has 3 types (reference
+    runtime 1161.8 s), ``example_small_20`` has 4 (2.7 s) — here both solve in
+    well under a second, exactly.
+    """
+    from citizensassemblies_tpu.solvers.compositions import (
+        enumerate_compositions,
+        expand_compositions,
+        leximin_over_compositions,
+    )
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+    reduction = TypeReduction(dense)
+    if reduction.T > cfg.enum_max_types:
+        return None
+    comps = enumerate_compositions(
+        reduction, cap=cfg.enum_cap, node_budget=cfg.enum_node_budget
+    )
+    if comps is None or len(comps) == 0:
+        return None
+    log.emit(
+        f"Type-space enumeration: {reduction.T} agent types, "
+        f"{len(comps)} feasible compositions."
+    )
+    with log.timer("typespace_lp"):
+        ts = leximin_over_compositions(
+            comps, reduction.msize, eps=cfg.eps, probe_tol=cfg.probe_tol, log=log
+        )
+    with log.timer("expand"):
+        P, _ = expand_compositions(
+            ts.compositions,
+            ts.probabilities,
+            reduction,
+            budget=cfg.expand_budget,
+            support_eps=cfg.support_eps,
+        )
+    fixed_agent = ts.type_values[reduction.type_id]
+    # polish: re-solve the final stage in agent space over the expanded
+    # candidate panels — a basic optimal solution is sparse (≤ n+1 panels,
+    # comparable to the reference's portfolios) and removes the residual
+    # construction error of the equidistributed expansion
+    with log.timer("final_stage"):
+        if final_stage == "l2":
+            from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
+
+            probs, eps_dev = solve_final_primal_l2(P, fixed_agent)
+        else:
+            probs, eps_dev = solve_final_primal_lp(P, fixed_agent)
+    probs = np.clip(probs, 0.0, 1.0)
+    keep = probs > cfg.support_eps
+    if final_stage != "l2":
+        P, probs = P[keep], probs[keep]
+    probs = probs / probs.sum()
+    allocation = P.T.astype(np.float64) @ probs
+    coverable = comps.max(axis=0) > 0
+    covered = coverable[reduction.type_id]
+    log.emit(
+        f"Leximin done (enumerated): {ts.stages} stages, {ts.lp_solves} LP solves, "
+        f"{P.shape[0]} panels in portfolio, final ε = {eps_dev:.2e}, "
+        f"max |alloc − target| = {np.max(np.abs(allocation - fixed_agent)):.2e}."
+    )
+    log.emit(format_timers(log.timers))
+    return Distribution(
+        committees=P,
+        probabilities=probs,
+        allocation=allocation,
+        output_lines=list(log.lines),
+        fixed_probabilities=fixed_agent,
+        covered=covered,
+    )
+
+
 def find_distribution_leximin(
     dense: DenseInstance,
     space: Optional[FeatureSpace] = None,
@@ -193,6 +275,19 @@ def find_distribution_leximin(
         space = FeatureSpace(categories=(), cells=())
     oracle = HighsCommitteeOracle(dense, households=households)
     check_feasible_or_suggest(dense, space, oracle, households)
+
+    # Fast exact path: full type-space enumeration (households couple specific
+    # agents and break type interchangeability, so they take the CG path; a
+    # valid mid-run checkpoint means CG work exists to resume, honor it).
+    if households is None and not initial_panels:
+        has_ckpt = checkpoint_path is not None and (
+            load_cg_state(checkpoint_path, n, problem_fingerprint(dense, cfg, households))
+            is not None
+        )
+        if not has_ckpt:
+            dist = _enumerated_leximin(dense, cfg, log, final_stage)
+            if dist is not None:
+                return dist
 
     key = jax.random.PRNGKey(cfg.solver_seed)
     portfolio = _Portfolio(n)

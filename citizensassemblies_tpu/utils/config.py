@@ -58,6 +58,23 @@ class Config:
     #: maximum committees held in the padded portfolio buffer (static shape).
     max_portfolio: int = 8_192
 
+    # --- type-space enumeration ----------------------------------------------
+    #: run LEXIMIN over the full enumeration of feasible compositions when the
+    #: instance has at most this many distinct agent types (agents with equal
+    #: feature rows are interchangeable; the leximin allocation is unique and
+    #: hence type-symmetric, so this path is exact).
+    enum_max_types: int = 16
+    #: abandon enumeration beyond this many feasible compositions.
+    enum_cap: int = 200_000
+    #: abandon enumeration beyond this many search nodes.
+    enum_node_budget: int = 3_000_000
+    #: panel budget when expanding a composition distribution into concrete
+    #: panels (bounds both the portfolio size and, on the equidistributed
+    #: path, the per-composition allocation error ≈ 1/expand_budget).
+    expand_budget: int = 4_096
+    #: probe-LP tolerance certifying that a type cannot exceed the stage value.
+    probe_tol: float = 1e-7
+
     # --- XMIN -----------------------------------------------------------------
     #: portfolio-expansion iterations as a multiple of n (reference ``xmin.py:511``).
     xmin_iterations_factor: int = 5
