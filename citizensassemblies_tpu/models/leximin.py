@@ -179,7 +179,6 @@ def _enumerated_leximin(
     """
     from citizensassemblies_tpu.solvers.compositions import (
         enumerate_compositions,
-        expand_compositions,
         leximin_over_compositions,
     )
     from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
@@ -200,26 +199,36 @@ def _enumerated_leximin(
         ts = leximin_over_compositions(
             comps, reduction.msize, eps=cfg.eps, probe_tol=cfg.probe_tol, log=log
         )
-    with log.timer("expand"):
-        P, _ = expand_compositions(
-            ts.compositions,
-            ts.probabilities,
-            reduction,
-            budget=cfg.expand_budget,
-            support_eps=cfg.support_eps,
-        )
     fixed_agent = ts.type_values[reduction.type_id]
-    # polish: re-solve the final stage in agent space over the expanded
-    # candidate panels — a basic optimal solution is sparse (≤ n+1 panels,
-    # comparable to the reference's portfolios) and removes the residual
-    # construction error of the equidistributed expansion
+    # decompose into concrete panels matching the exact type targets: CG on
+    # the final LP with closed-form pricing (top-c_t dual weights per type);
+    # a basic optimal solution is sparse (≤ n+1 panels, comparable to the
+    # reference's portfolios) and ε converges to ~0
     with log.timer("final_stage"):
         if final_stage == "l2":
+            from citizensassemblies_tpu.solvers.compositions import expand_compositions
             from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
 
+            P, _ = expand_compositions(
+                ts.compositions,
+                ts.probabilities,
+                reduction,
+                budget=cfg.expand_budget,
+                support_eps=cfg.support_eps,
+            )
             probs, eps_dev = solve_final_primal_l2(P, fixed_agent)
         else:
-            probs, eps_dev = solve_final_primal_lp(P, fixed_agent)
+            from citizensassemblies_tpu.solvers.compositions import decompose_with_pricing
+
+            P, probs, eps_dev = decompose_with_pricing(
+                ts.compositions,
+                ts.probabilities,
+                reduction,
+                fixed_agent,
+                budget=cfg.expand_budget,
+                support_eps=cfg.support_eps,
+                log=log,
+            )
     probs = np.clip(probs, 0.0, 1.0)
     keep = probs > cfg.support_eps
     if final_stage != "l2":

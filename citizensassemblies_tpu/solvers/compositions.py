@@ -239,6 +239,156 @@ def leximin_over_compositions(
     )
 
 
+def greedy_decompose(
+    comps: np.ndarray,
+    probs: np.ndarray,
+    reduction: TypeReduction,
+    targets: np.ndarray,
+    support_eps: float = 1e-11,
+    max_panels: int = 16_384,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Water-filling decomposition of a composition distribution into panels.
+
+    Serves each composition's probability mass in slices; every slice's panel
+    takes, per type, the ``c_t`` members with the largest remaining need
+    (need = target probability not yet realized), ties rotated by a per-type
+    cursor so equal-need members are cycled fairly. The slice probability is
+    the largest step that overshoots no member. Exact up to float rounding on
+    most instances (the caller verifies and LP-polishes any residual);
+    portfolio size is typically O(Σ_t m_t/c_t) per support composition.
+    """
+    sel = probs > support_eps
+    comps = comps[sel]
+    p = probs[sel].astype(np.float64)
+    p = p / p.sum()
+    n = reduction.n
+    T = reduction.T
+    msize = reduction.msize
+    members = reduction.members
+
+    needs = [np.full(int(msize[t]), 0.0) for t in range(T)]
+    for t in range(T):
+        needs[t][:] = targets[members[t][0]] if len(members[t]) else 0.0
+    cursors = np.zeros(T, dtype=np.int64)
+
+    # serve compositions largest-first so late slices retain mixing freedom
+    order = np.argsort(-p)
+    panels: List[np.ndarray] = []
+    pprobs: List[float] = []
+    for s in order:
+        c = comps[s]
+        rho = float(p[s])
+        while rho > 1e-12 and len(panels) < max_panels:
+            row = np.zeros(n, dtype=bool)
+            delta = rho
+            chosen: List[Tuple[int, np.ndarray]] = []
+            for t in range(T):
+                ct, mt = int(c[t]), int(msize[t])
+                if not ct:
+                    continue
+                rot = (np.arange(mt) - cursors[t]) % mt
+                idx = np.lexsort((rot, -needs[t]))[:ct]
+                chosen.append((t, idx))
+                m = float(needs[t][idx].min())
+                if m > 1e-15:
+                    delta = min(delta, m)
+            if delta <= 1e-15:
+                delta = rho  # forced overshoot; the LP polish absorbs it
+            for t, idx in chosen:
+                row[members[t][idx]] = True
+                needs[t][idx] -= delta
+                cursors[t] = (cursors[t] + int(c[t])) % max(int(msize[t]), 1)
+            panels.append(row)
+            pprobs.append(delta)
+            rho -= delta
+    return np.stack(panels, axis=0), np.asarray(pprobs, dtype=np.float64)
+
+
+def decompose_with_pricing(
+    comps: np.ndarray,
+    probs: np.ndarray,
+    reduction: TypeReduction,
+    targets: np.ndarray,
+    budget: int = 1024,
+    support_eps: float = 1e-11,
+    max_rounds: int = 200,
+    log: Optional[RunLog] = None,
+) -> Tuple[np.ndarray, np.ndarray, float]:
+    """Exact panel decomposition of a composition distribution.
+
+    Finds concrete panels and probabilities whose per-agent allocation matches
+    ``targets`` up to LP tolerance, via column generation on the final LP
+    (min ε s.t. ``Pᵀp ≥ targets − ε``, ``Σp = 1``) with **closed-form
+    pricing**: the best panel for dual weights ``y`` within a feasible
+    composition ``c`` simply takes each type's ``c_t`` highest-weight members,
+    so pricing over the full enumeration is one prefix-sum lookup per
+    composition — no ILP, unlike the reference's committee pricing
+    (``leximin.py:420-424``). An exact decomposition always exists (uniform
+    within-type selection is a finite convex combination of concrete panels),
+    so ε converges to ~0. Returns ``(panels bool [R, n], probs, ε)``.
+    """
+    log = log or RunLog(echo=False)
+    n = reduction.n
+    T = reduction.T
+    members = reduction.members
+    maxm = reduction.maxm
+
+    # seed: greedy water-filling decomposition — usually already exact, in
+    # which case no LP runs at all
+    P0, q0 = greedy_decompose(comps, probs, reduction, targets, support_eps=support_eps)
+    total = q0.sum()
+    if abs(total - 1.0) < 1e-9:
+        dev = float(np.max(targets - P0.T.astype(np.float64) @ q0))
+        if dev <= 1e-9:
+            return P0, q0 / total, max(dev, 0.0)
+    rows: List[np.ndarray] = [r for r in P0]
+    seen = {r.tobytes() for r in rows}
+
+    from citizensassemblies_tpu.solvers.highs_backend import solve_final_primal_lp_duals
+
+    add_per_round = 32
+    p = None
+    eps_dev = 1.0
+    for _ in range(max_rounds):
+        P = np.stack(rows, axis=0)
+        p, eps_dev, y, mu = solve_final_primal_lp_duals(P, targets)
+        if eps_dev <= 1e-9:
+            break
+        # price: value(c) = Σ_t (sum of the c_t largest y within type t)
+        prefix = np.zeros((T, maxm + 1))
+        tops: List[np.ndarray] = []
+        for t in range(T):
+            order = members[t][np.argsort(-y[members[t]], kind="stable")]
+            tops.append(order)
+            prefix[t, 1 : len(order) + 1] = np.cumsum(y[order])
+        values = prefix[np.arange(T)[None, :], comps].sum(axis=1)  # [C]
+        cand = np.argsort(-values)[: add_per_round]
+        cand = cand[values[cand] > -mu + 1e-10]
+        if len(cand) == 0:
+            break  # no improving panel exists anywhere: ε is optimal
+        added = 0
+        for ci in cand:
+            row = np.zeros(n, dtype=bool)
+            for t in range(T):
+                ct = int(comps[ci, t])
+                if ct:
+                    row[tops[t][:ct]] = True
+            kb = row.tobytes()
+            if kb not in seen:
+                seen.add(kb)
+                rows.append(row)
+                added += 1
+        if added == 0:
+            break  # numerically stalled
+        p = None
+    if p is None or len(p) != len(rows):
+        P = np.stack(rows, axis=0)
+        p, eps_dev, _, _ = solve_final_primal_lp_duals(P, targets)
+    else:
+        P = np.stack(rows, axis=0)
+    return P, p, float(eps_dev)
+
+
 def expand_compositions(
     comps: np.ndarray,
     probs: np.ndarray,

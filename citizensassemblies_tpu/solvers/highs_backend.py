@@ -341,6 +341,32 @@ def solve_dual_lp(
     return DualSolution(ok=True, y=res.x[:n], yhat=float(res.x[n]), objective=float(res.fun))
 
 
+def solve_final_primal_lp_duals(
+    P: np.ndarray, target: np.ndarray
+) -> Tuple[np.ndarray, float, np.ndarray, float]:
+    """``solve_final_primal_lp`` variant also returning the dual solution:
+    ``(p, ε, y, μ)`` where ``y ≥ 0`` are the agent-coverage duals and ``μ`` the
+    normalization dual — the quantities column-generation pricing needs
+    (reduced cost of a candidate panel column is ``−y·panel − μ``)."""
+    P = np.asarray(P, dtype=np.float64)
+    C, n = P.shape
+    target = np.asarray(target, dtype=np.float64)
+    c = np.zeros(C + 1)
+    c[-1] = 1.0
+    A_ub = np.hstack([-P.T, -np.ones((n, 1))])
+    b_ub = -target
+    A_eq = np.concatenate([np.ones(C), [0.0]])[None, :]
+    b_eq = np.array([1.0])
+    res = linprog(
+        c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=(0, None), method="highs"
+    )
+    if res.status != 0 or res.x is None:
+        raise SelectionError(f"final primal LP failed (HiGHS status {res.status}: {res.message})")
+    y = -np.asarray(res.ineqlin.marginals)
+    mu = float(res.eqlin.marginals[0])
+    return res.x[:C], float(res.x[C]), y, mu
+
+
 def solve_final_primal_lp(P: np.ndarray, target: np.ndarray) -> Tuple[np.ndarray, float]:
     """Recover committee probabilities realizing the fixed per-agent targets.
 
