@@ -1,0 +1,135 @@
+"""Type-space enumeration, stage-LP leximin, and exact panel decomposition
+(``solvers/compositions.py``) — the fast path behind ``find_distribution_leximin``
+for instances with few distinct agent types."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import cross_product_instance, random_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.solvers.compositions import (
+    decompose_with_pricing,
+    enumerate_compositions,
+    expand_compositions,
+    greedy_decompose,
+    leximin_over_compositions,
+)
+from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+
+def _brute_compositions(red):
+    """All feasible compositions by direct product enumeration."""
+    out = []
+    ranges = [range(int(m) + 1) for m in red.msize]
+    for c in itertools.product(*ranges):
+        if sum(c) != red.k:
+            continue
+        counts = np.zeros(red.F, dtype=int)
+        for t, ct in enumerate(c):
+            counts[red.type_feature[t]] += ct
+        if np.all(counts >= red.qmin) and np.all(counts <= red.qmax):
+            out.append(c)
+    return sorted(out)
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+def test_enumeration_matches_bruteforce(seed):
+    inst = random_instance(n=14, k=4, n_categories=2, features_per_category=2, seed=seed)
+    dense, _ = featurize(inst)
+    red = TypeReduction(dense)
+    comps = enumerate_compositions(red)
+    assert comps is not None
+    got = sorted(tuple(int(x) for x in row) for row in comps)
+    assert got == _brute_compositions(red)
+
+
+def test_enumeration_cap_returns_none():
+    inst = random_instance(n=64, k=20, n_categories=2, features_per_category=2, seed=1)
+    dense, _ = featurize(inst)
+    red = TypeReduction(dense)
+    assert enumerate_compositions(red, cap=3) is None
+
+
+def _large_like():
+    return cross_product_instance(
+        categories=["gender", "leaning"],
+        features=[["female", "male"], ["liberal", "conservative"]],
+        quotas=[[(99, 200), (99, 200)], [(99, 200), (99, 200)]],
+        counts=[999, 1, 0, 1000],
+        k=200,
+        name="example_large_200_like",
+    )
+
+
+def test_typespace_leximin_large_like_uniform():
+    """The skewed example_large-shaped pool still admits the uniform k/n
+    allocation (min prob 10.0%, the reference's golden value), and the stage
+    LPs find it exactly."""
+    dense, _ = featurize(_large_like())
+    red = TypeReduction(dense)
+    comps = enumerate_compositions(red)
+    ts = leximin_over_compositions(comps, red.msize)
+    assert ts.type_values == pytest.approx([0.1, 0.1, 0.1], abs=1e-9)
+    # distribution realizes the targets
+    M = comps / red.msize[None, :]
+    np.testing.assert_allclose(ts.probabilities @ M, ts.type_values, atol=1e-8)
+
+
+def test_greedy_decompose_near_exact_large_like():
+    dense, _ = featurize(_large_like())
+    red = TypeReduction(dense)
+    comps = enumerate_compositions(red)
+    ts = leximin_over_compositions(comps, red.msize)
+    targets = ts.type_values[red.type_id]
+    P, q = greedy_decompose(comps, ts.probabilities, red, targets)
+    assert q.sum() == pytest.approx(1.0, abs=1e-9)
+    # greedy alone may strand a ~1e-6 residual on a few agents; the pricing
+    # CG wrapper below removes it (that pairing is the shipped pipeline)
+    np.testing.assert_allclose(P.T.astype(float) @ q, targets, atol=1e-5)
+    # every panel quota-feasible
+    counts = P.astype(np.int64) @ np.asarray(dense.A)
+    assert np.all(counts >= np.asarray(dense.qmin)[None, :])
+    assert np.all(counts <= np.asarray(dense.qmax)[None, :])
+    assert np.all(P.sum(axis=1) == dense.k)
+
+
+def test_decompose_with_pricing_exact_large_like():
+    dense, _ = featurize(_large_like())
+    red = TypeReduction(dense)
+    comps = enumerate_compositions(red)
+    ts = leximin_over_compositions(comps, red.msize)
+    targets = ts.type_values[red.type_id]
+    P, q, eps = decompose_with_pricing(comps, ts.probabilities, red, targets)
+    assert eps <= 1e-8
+    assert np.all(P.T.astype(float) @ q >= targets - 1e-8)
+
+
+@pytest.mark.parametrize("seed", [2, 5])
+def test_decompose_with_pricing_random(seed):
+    inst = random_instance(n=40, k=8, n_categories=2, features_per_category=2, seed=seed)
+    dense, _ = featurize(inst)
+    red = TypeReduction(dense)
+    comps = enumerate_compositions(red)
+    assert comps is not None and len(comps) > 0
+    ts = leximin_over_compositions(comps, red.msize)
+    targets = ts.type_values[red.type_id]
+    P, q, eps = decompose_with_pricing(comps, ts.probabilities, red, targets)
+    assert eps <= 1e-8
+    alloc = P.T.astype(float) @ q
+    assert np.all(alloc >= targets - 1e-8)
+
+
+def test_expand_compositions_exact_lcm_path():
+    """Tiny sizes take the exact LCM rotation path: per-agent allocation is
+    exactly c_t/m_t-weighted."""
+    inst = random_instance(n=12, k=4, n_categories=2, features_per_category=2, seed=9)
+    dense, _ = featurize(inst)
+    red = TypeReduction(dense)
+    comps = enumerate_compositions(red)
+    ts = leximin_over_compositions(comps, red.msize)
+    P, q = expand_compositions(comps, ts.probabilities, red, budget=4096)
+    M = comps / red.msize[None, :]
+    target = (ts.probabilities @ M)[red.type_id]
+    np.testing.assert_allclose(P.T.astype(float) @ q, target, atol=1e-9)
