@@ -162,20 +162,21 @@ def _seed_portfolio(
     return covered
 
 
-def _enumerated_leximin(
+def _typespace_leximin(
     dense: DenseInstance,
     cfg: Config,
     log: RunLog,
     final_stage: str,
 ) -> Optional[Distribution]:
-    """Exact leximin via full type-space enumeration, when the instance has
-    few distinct agent types (see ``solvers/compositions.py``).
+    """Exact leximin in type space (see ``solvers/compositions.py``).
 
-    Returns None when the instance is not enumerable within budget, in which
-    case the caller falls back to column generation. The headline reference
-    instances all qualify: ``example_large_200`` has 3 types (reference
-    runtime 1161.8 s), ``example_small_20`` has 4 (2.7 s) — here both solve in
-    well under a second, exactly.
+    Agents with identical feature rows are interchangeable, so the problem
+    collapses onto distinct types: full enumeration of feasible compositions
+    when the type count is small (the headline reference instances qualify —
+    ``example_large_200`` has 3 types, reference runtime 1161.8 s;
+    ``example_small_20`` has 4, 2.7 s; both solve here in under a second,
+    exactly), otherwise column generation over compositions
+    (``solvers/cg_typespace.py``).
     """
     from citizensassemblies_tpu.solvers.compositions import (
         enumerate_compositions,
@@ -184,21 +185,33 @@ def _enumerated_leximin(
     from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
 
     reduction = TypeReduction(dense)
-    if reduction.T > cfg.enum_max_types:
-        return None
-    comps = enumerate_compositions(
-        reduction, cap=cfg.enum_cap, node_budget=cfg.enum_node_budget
-    )
-    if comps is None or len(comps) == 0:
-        return None
-    log.emit(
-        f"Type-space enumeration: {reduction.T} agent types, "
-        f"{len(comps)} feasible compositions."
-    )
-    with log.timer("typespace_lp"):
-        ts = leximin_over_compositions(
-            comps, reduction.msize, eps=cfg.eps, probe_tol=cfg.probe_tol, log=log
+    comps = None
+    if reduction.T <= cfg.enum_max_types:
+        comps = enumerate_compositions(
+            reduction, cap=cfg.enum_cap, node_budget=cfg.enum_node_budget
         )
+        if comps is not None and len(comps) == 0:
+            comps = None
+    if comps is not None:
+        log.emit(
+            f"Type-space enumeration: {reduction.T} agent types, "
+            f"{len(comps)} feasible compositions."
+        )
+        with log.timer("typespace_lp"):
+            ts = leximin_over_compositions(
+                comps, reduction.msize, eps=cfg.eps, probe_tol=cfg.probe_tol, log=log
+            )
+    else:
+        # too many types to enumerate: column generation over compositions,
+        # with TPU-batched stochastic pricing and exact MILP certification
+        from citizensassemblies_tpu.solvers.cg_typespace import leximin_cg_typespace
+
+        log.emit(
+            f"Type-space column generation: {reduction.T} agent types "
+            f"(enumeration over budget)."
+        )
+        with log.timer("typespace_cg"):
+            ts = leximin_cg_typespace(dense, reduction, cfg=cfg, log=log)
     fixed_agent = ts.type_values[reduction.type_id]
     # decompose into concrete panels matching the exact type targets: CG on
     # the final LP with closed-form pricing (top-c_t dual weights per type);
@@ -235,10 +248,12 @@ def _enumerated_leximin(
         P, probs = P[keep], probs[keep]
     probs = probs / probs.sum()
     allocation = P.T.astype(np.float64) @ probs
-    coverable = comps.max(axis=0) > 0
+    coverable = (
+        ts.coverable if hasattr(ts, "coverable") else ts.compositions.max(axis=0) > 0
+    )
     covered = coverable[reduction.type_id]
     log.emit(
-        f"Leximin done (enumerated): {ts.stages} stages, {ts.lp_solves} LP solves, "
+        f"Leximin done (type space): {ts.stages} stages, {ts.lp_solves} LP solves, "
         f"{P.shape[0]} panels in portfolio, final ε = {eps_dev:.2e}, "
         f"max |alloc − target| = {np.max(np.abs(allocation - fixed_agent)):.2e}."
     )
@@ -294,7 +309,7 @@ def find_distribution_leximin(
             is not None
         )
         if not has_ckpt:
-            dist = _enumerated_leximin(dense, cfg, log, final_stage)
+            dist = _typespace_leximin(dense, cfg, log, final_stage)
             if dist is not None:
                 return dist
 

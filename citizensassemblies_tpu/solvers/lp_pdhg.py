@@ -265,6 +265,51 @@ def solve_dual_lp_pdhg(
     )
 
 
+def solve_stage_lp_pdhg(
+    MT: np.ndarray,
+    fixed: np.ndarray,
+    cfg: Optional[Config] = None,
+    warm: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+):
+    """Type-space stage LP (max the min unfixed type value) on device.
+
+    Variables x = [p (C), z]; min −z s.t. z − M_t·p ≤ 0 (t unfixed),
+    −M_t·p ≤ −f_t (t fixed), Σp = 1, x ≥ 0. The λ duals of the ≤-rows are the
+    per-type weights column-generation pricing needs. The column dimension is
+    padded to a bucket (zero G/eq coefficients, zero cost — padding variables
+    stay at 0) so the jitted PDHG core compiles once per bucket while the
+    portfolio grows. Returns ``(z, y, mu, p, ok)`` plus the raw warm triple.
+    """
+    cfg = cfg or default_config()
+    T, C = MT.shape
+    fixed = np.asarray(fixed, dtype=np.float64)
+    unfixed = fixed < 0
+
+    bucket = 512
+    Cp = ((C + bucket - 1) // bucket) * bucket
+    G = np.zeros((T, Cp + 1))
+    G[:, :C] = -MT
+    G[unfixed, Cp] = 1.0
+    h = np.where(unfixed, 0.0, -(np.maximum(fixed, 0.0) - 1e-9))
+    A = np.zeros((1, Cp + 1))
+    A[0, :C] = 1.0
+    b = np.array([1.0])
+    c = np.zeros(Cp + 1)
+    c[Cp] = -1.0
+    if warm is not None and warm[0].shape[0] != Cp + 1:
+        x_w = np.zeros(Cp + 1)
+        m = min(C, warm[0].shape[0] - 1)
+        x_w[:m] = warm[0][:m]
+        x_w[Cp] = warm[0][-1]
+        warm = (x_w, warm[1], warm[2])
+    sol = solve_lp(c, G, h, A, b, cfg=cfg, warm=warm)
+    z = float(sol.x[Cp])
+    y = np.maximum(sol.lam, 0.0)
+    mu = float(sol.mu[0])
+    p = sol.x[:C]
+    return z, y, mu, p, sol.ok, (sol.x, sol.lam, sol.mu)
+
+
 def solve_final_primal_lp_pdhg(
     P: np.ndarray,
     target: np.ndarray,
