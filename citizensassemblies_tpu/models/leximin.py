@@ -241,6 +241,9 @@ def _typespace_leximin(
                 budget=cfg.expand_budget,
                 support_eps=cfg.support_eps,
                 log=log,
+                # no point polishing the panel decomposition below the
+                # tolerance already accepted at the type level
+                tol=getattr(ts, "eps_dev", 0.0),
             )
     probs = np.clip(probs, 0.0, 1.0)
     keep = probs > cfg.support_eps

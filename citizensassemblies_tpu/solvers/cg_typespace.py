@@ -79,6 +79,163 @@ class CompositionOracle:
         return comp, float(-res.fun)
 
 
+def _relaxation_bound(
+    reduction: TypeReduction, fixed: np.ndarray
+) -> Tuple[float, np.ndarray]:
+    """Stage upper bound from the LP relaxation over expected type counts.
+
+    ``max z`` over fractional ``x ∈ [0, m]`` with ``Σx = k``, feature quota
+    rows, ``x_t ≥ z·m_t`` (unfixed) and ``x_t ≥ f_t·m_t`` (fixed). Any
+    distribution over feasible compositions has its expectation in this
+    polytope, so no stage can exceed ``z_UB``; when the master LP reaches it,
+    the stage is certified optimal without an exact pricing call. The
+    optimizer ``x*`` is a vertex with at most #rows fractional coordinates —
+    its randomized roundings are injected as master columns so the portfolio
+    spans near-optimal mixtures immediately instead of discovering them one
+    pricing round at a time.
+    """
+    T, F = reduction.T, reduction.F
+    tf = np.zeros((T, F))
+    for t in range(T):
+        tf[t, reduction.type_feature[t]] = 1.0
+    m = reduction.msize.astype(np.float64)
+    unfixed = fixed < 0
+    # variables [x (T), z]
+    c = np.zeros(T + 1)
+    c[T] = -1.0
+    rows = []
+    b = []
+    # quota rows: lo ≤ tfᵀ x ≤ hi  →  two inequality blocks
+    rows.append(np.concatenate([-tf.T, np.zeros((F, 1))], axis=1))
+    b.append(-reduction.qmin.astype(np.float64))
+    rows.append(np.concatenate([tf.T, np.zeros((F, 1))], axis=1))
+    b.append(reduction.qmax.astype(np.float64))
+    # floor rows: z·m_t − x_t ≤ 0 (unfixed), f_t·m_t − x_t ≤ 0 (fixed)
+    floor = np.zeros((T, T + 1))
+    floor[np.arange(T), np.arange(T)] = -1.0
+    floor[unfixed, T] = m[unfixed]
+    rows.append(floor)
+    b.append(np.where(unfixed, 0.0, -(np.maximum(fixed, 0.0) * m - _SLACK)))
+    A_ub = np.concatenate(rows, axis=0)
+    b_ub = np.concatenate(b)
+    A_eq = np.concatenate([np.ones(T), [0.0]])[None, :]
+    res = scipy.optimize.linprog(
+        c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=[float(reduction.k)],
+        bounds=[(0, mm) for mm in m] + [(0, None)], method="highs",
+    )
+    if res.status != 0:
+        return float("inf"), np.zeros(T)
+    return float(res.x[T]), res.x[:T]
+
+
+def _round_relaxation(
+    x: np.ndarray,
+    reduction: TypeReduction,
+    rng: np.random.Generator,
+    count: int = 256,
+) -> List[np.ndarray]:
+    """Randomized quota-feasible integer roundings of a fractional type-count
+    vector (probability-proportional on the fractional coordinates, with a
+    Σ=k repair step); infeasible roundings are discarded."""
+    T = reduction.T
+    k = reduction.k
+    lo = reduction.qmin
+    hi = reduction.qmax
+    base = np.floor(x).astype(np.int64)
+    frac = x - base
+    fidx = np.nonzero(frac > 1e-12)[0]
+    tf = np.zeros((T, reduction.F), dtype=np.int64)
+    for t in range(T):
+        tf[t, reduction.type_feature[t]] = 1
+    cands = np.repeat(base[None, :], count, axis=0)
+    for r in range(count):
+        c = cands[r]
+        c[fidx] += rng.random(len(fidx)) < frac[fidx]
+        gap = k - int(c.sum())
+        order = rng.permutation(fidx)
+        for t in order:
+            if gap == 0:
+                break
+            if gap > 0 and c[t] == base[t]:
+                c[t] += 1
+                gap -= 1
+            elif gap < 0 and c[t] > base[t]:
+                c[t] -= 1
+                gap += 1
+        if gap != 0:
+            c[0] = -1  # mark infeasible
+    ok = cands[:, 0] >= 0
+    counts = cands @ tf  # [count, F]
+    ok &= np.all(counts >= lo[None, :], axis=1) & np.all(counts <= hi[None, :], axis=1)
+    return [c.astype(np.int32) for c in cands[ok]]
+
+
+def _leximin_relaxation(
+    reduction: TypeReduction,
+    eps: float,
+    log: Optional[RunLog] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact leximin of ``x/m`` over the marginal relaxation polytope
+    ``X = {x ∈ [0, m] : Σx = k, lo ≤ tfᵀx ≤ hi}``.
+
+    Every achievable allocation profile is the expectation of a composition
+    distribution and hence lies in ``X/m``, so this leximin profile dominates
+    the true one in leximin order; when the decomposition LP later realizes it
+    exactly (ε ≈ 0), it *is* the true leximin — certified without any
+    stage-wise column generation. Runs the same fix-tranche stage loop as
+    ``leximin_over_compositions`` but each stage is a T-variable, (2F+T)-row
+    LP solved in milliseconds. Returns ``(v [T] leximin type values,
+    x_final [T] an optimal marginal)``.
+    """
+    log = log or RunLog(echo=False)
+    T, F = reduction.T, reduction.F
+    tf = np.zeros((T, F))
+    for t in range(T):
+        tf[t, reduction.type_feature[t]] = 1.0
+    m = reduction.msize.astype(np.float64)
+    k = float(reduction.k)
+    fixed = np.full(T, -1.0)
+    x_last = np.zeros(T)
+    quota_rows = np.concatenate(
+        [np.concatenate([-tf.T, np.zeros((F, 1))], axis=1),
+         np.concatenate([tf.T, np.zeros((F, 1))], axis=1)], axis=0
+    )
+    quota_b = np.concatenate(
+        [-reduction.qmin.astype(np.float64), reduction.qmax.astype(np.float64)]
+    )
+    stage = 0
+    while (fixed < 0).any():
+        stage += 1
+        unfixed = fixed < 0
+        floor = np.zeros((T, T + 1))
+        floor[np.arange(T), np.arange(T)] = -1.0
+        floor[unfixed, T] = m[unfixed]
+        floor_b = np.where(unfixed, 0.0, -(np.maximum(fixed, 0.0) * m - _SLACK))
+        A_ub = np.concatenate([quota_rows, floor], axis=0)
+        b_ub = np.concatenate([quota_b, floor_b])
+        c = np.zeros(T + 1)
+        c[T] = -1.0
+        res = scipy.optimize.linprog(
+            c, A_ub=A_ub, b_ub=b_ub,
+            A_eq=np.concatenate([np.ones(T), [0.0]])[None, :], b_eq=[k],
+            bounds=[(0, mm) for mm in m] + [(0, None)], method="highs",
+        )
+        if res.status != 0:
+            raise RuntimeError(f"relaxation stage LP failed: {res.message}")
+        z = float(res.x[T])
+        x_last = res.x[:T]
+        y = -np.asarray(res.ineqlin.marginals)[2 * F :]  # floor-row duals
+        newly = (y > eps) & unfixed
+        if not newly.any():
+            unfixed_idx = np.nonzero(unfixed)[0]
+            newly = np.zeros(T, dtype=bool)
+            newly[unfixed_idx[np.argmax(y[unfixed_idx])]] = True
+        fixed = np.where(newly, max(0.0, z), fixed)
+    log.emit(f"Relaxation leximin: {stage} stages, values in "
+             f"[{fixed.min():.6f}, {fixed.max():.6f}].")
+    return fixed, x_last
+
+
 @dataclasses.dataclass
 class TypeCGResult:
     compositions: np.ndarray  # int32 [C, T] generated portfolio
@@ -88,26 +245,36 @@ class TypeCGResult:
     stages: int
     lp_solves: int
     exact_prices: int
+    eps_dev: float = 0.0  # accepted downward deviation of the distribution
 
 
 def _stage_lp(
-    MT: np.ndarray, fixed: np.ndarray
+    MT: np.ndarray,
+    fixed: np.ndarray,
+    targets: Optional[np.ndarray] = None,
 ) -> Tuple[float, np.ndarray, float, np.ndarray]:
     """Maximize the minimum unfixed type value over the portfolio.
 
     Returns ``(z*, y, mu, p)`` where ``y ≥ 0`` are per-unfixed-type duals
     (Σy = 1), ``mu`` the normalization dual — a candidate composition ``c``
     improves the stage iff ``Σ_t ŷ_t c_t/m_t > −mu`` with ``ŷ`` the full dual
-    vector (fixed types included).
+    vector (fixed types included). With ``targets`` given every row becomes
+    ``M_t·p ≥ v_t + z`` (the decomposition feasibility LP; ``ε = max(0, −z*)``).
     """
     T, C = MT.shape
-    unfixed = np.nonzero(fixed < 0)[0]
-    done = np.nonzero(fixed >= 0)[0]
+    if targets is not None:
+        unfixed = np.arange(T)
+        done = np.zeros(0, dtype=int)
+    else:
+        unfixed = np.nonzero(fixed < 0)[0]
+        done = np.nonzero(fixed >= 0)[0]
     nu, nd = len(unfixed), len(done)
     A_ub = np.zeros((nu + nd, C + 1))
     A_ub[:nu, :C] = -MT[unfixed]
     A_ub[:nu, C] = 1.0
     b_ub = np.zeros(nu + nd)
+    if targets is not None:
+        b_ub[:nu] = -(np.asarray(targets, dtype=np.float64) - _SLACK)
     if nd:
         A_ub[nu:, :C] = -MT[done]
         b_ub[nu:] = -(fixed[done] - _SLACK)
@@ -115,14 +282,25 @@ def _stage_lp(
     A_eq[0, C] = 0.0
     c_obj = np.zeros(C + 1)
     c_obj[C] = -1.0
+    # interior point, sparse: the master is maximally degenerate (hundreds of
+    # near-active rows), where simplex crawls — the same reason the reference
+    # forces Gurobi's barrier (leximin.py:325-327); interior duals also fix
+    # larger tranches via strict complementarity
+    A_ub_s = scipy.sparse.csr_matrix(A_ub)
+    A_eq_s = scipy.sparse.csr_matrix(A_eq)
     res = scipy.optimize.linprog(
-        c_obj, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=[1.0],
-        bounds=[(0, None)] * C + [(None, None)], method="highs",
+        c_obj, A_ub=A_ub_s, b_ub=b_ub, A_eq=A_eq_s, b_eq=[1.0],
+        bounds=[(0, None)] * C + [(None, None)], method="highs-ipm",
     )
+    if res.status != 0:
+        res = scipy.optimize.linprog(
+            c_obj, A_ub=A_ub_s, b_ub=b_ub, A_eq=A_eq_s, b_eq=[1.0],
+            bounds=[(0, None)] * C + [(None, None)], method="highs",
+        )
     if res.status != 0:
         raise RuntimeError(f"type-space stage LP failed: {res.message}")
     marg = -np.asarray(res.ineqlin.marginals)  # ≥ 0
-    y_full = np.zeros(len(fixed))
+    y_full = np.zeros(T)
     y_full[unfixed] = marg[:nu]
     if nd:
         y_full[done] = marg[nu:]
@@ -214,9 +392,159 @@ def leximin_cg_typespace(
         cfg.backend == "hybrid" and jax.default_backend() not in ("cpu",)
     )
     pdhg_warm = None
+    rng = np.random.default_rng(cfg.solver_seed)
 
+    # ---- phase 1: leximin of the marginal relaxation + one decomposition ----
+    # Solve leximin exactly over the tiny relaxation polytope (T stages of
+    # millisecond LPs), then try to realize that profile as one mixture of
+    # integer compositions. Success (ε ≈ 0) certifies the true leximin without
+    # any stage-wise column generation; an integrality residual falls back to
+    # the certified stage loop below.
+    with log.timer("relax_leximin"):
+        v_relax, x_star = _leximin_relaxation(reduction, cfg.eps, log)
+        v_relax = np.where(coverable, v_relax, 0.0)
+        for c in _round_relaxation(x_star, reduction, rng, count=512):
+            add_comp(c)
+    def prune_columns(p_now: np.ndarray, keep_last: int = 4000) -> None:
+        """Column management: keep the LP support plus the freshest columns.
+        Only as a memory backstop — every observed prune visibly slowed the
+        ε decay (discarded columns carry hull information), so the threshold
+        sits well above the portfolio a normal decomposition reaches."""
+        if len(comps) <= 12000:
+            return
+        keep = set(np.nonzero(p_now > 1e-12)[0].tolist())
+        keep.update(range(max(0, len(comps) - keep_last), len(comps)))
+        kept = [comps[i] for i in sorted(keep)]
+        comps.clear()
+        seen.clear()
+        for c in kept:
+            add_comp(c)
+
+    decomposed = False
+    import time as _time
+
+    for it in range(cfg.decomp_max_rounds):
+        t_round = _time.time()
+        M = np.stack(comps, axis=0).astype(np.float64) / msize[None, :]
+        MT = np.ascontiguousarray(M.T)
+        with log.timer("decomp_lp"):
+            # fast approximate rounds on device (warm-started PDHG at a loose
+            # tolerance is plenty for pricing guidance); an authoritative host
+            # IPM solve only when the estimate nears acceptance
+            authoritative = not use_pdhg
+            if use_pdhg:
+                from citizensassemblies_tpu.solvers.lp_pdhg import solve_stage_lp_pdhg
+
+                z, y, mu, probs, ok, pdhg_warm = solve_stage_lp_pdhg(
+                    MT, fixed, cfg=cfg, warm=pdhg_warm, targets=v_relax, tol=2e-5
+                )
+                if not ok or max(0.0, -z) <= 2.0 * cfg.decomp_accept:
+                    authoritative = True
+            if authoritative:
+                z, y, mu, probs = _stage_lp(MT, fixed, targets=v_relax)
+        lp_solves += 1
+        eps_dev = max(0.0, -z)
+        if authoritative and eps_dev <= cfg.decomp_accept:
+            decomposed = True
+            log.emit(
+                f"Decomposition: profile realized after {it + 1} round(s), "
+                f"ε = {eps_dev:.2e}, portfolio {len(comps)}."
+            )
+            break
+        if z >= -cfg.decomp_tol:
+            decomposed = True
+            log.emit(
+                f"Decomposition: relaxation profile realized after {it + 1} "
+                f"round(s), ε = {eps_dev:.2e}, portfolio {len(comps)}."
+            )
+            break
+        prune_columns(probs)
+        # price toward the targets: stochastic draw + exact MILP + roundings
+        w_type = y / msize
+        key, sub = jax.random.split(key)
+        with log.timer("stochastic_pricing"):
+            from citizensassemblies_tpu.solvers.pricing import _pricing_scores
+
+            scores = _pricing_scores(
+                np.asarray(w_type[type_id], dtype=np.float64), cfg.pricing_batch
+            )
+            panels, ok_mask = sample_panels_batch(
+                dense, sub, cfg.pricing_batch, scores=scores
+            )
+            cand = panels_to_comps(np.asarray(panels)[np.asarray(ok_mask)])
+        values = cand.astype(np.float64) @ w_type
+        added = 0
+        for i in np.argsort(-values):
+            if values[i] <= -mu + 1e-9:
+                break
+            if add_comp(cand[i]):
+                added += 1
+                if added >= cfg.cg_columns_typespace:
+                    break
+        with log.timer("exact_oracle"):
+            got = oracle.maximize(w_type)
+            exact_prices += 1
+            if got is not None and got[1] > -mu + 1e-9 and add_comp(got[0]):
+                added += 1
+            # multi-cut: extreme compositions at perturbed duals enlarge the
+            # master's hull much faster than interior samples
+            scale = float(np.mean(w_type[w_type > 0])) if (w_type > 0).any() else 1.0
+            for _ in range(cfg.decomp_multicut):
+                w_pert = np.maximum(w_type + rng.exponential(scale, T) * 0.5, 0.0)
+                got_p = oracle.maximize(w_pert)
+                exact_prices += 1
+                if got_p is not None and add_comp(got_p[0]):
+                    added += 1
+        log.emit(
+            f"  decomp round {it + 1}: ε={eps_dev:.2e} added {added} "
+            f"(portfolio {len(comps)}, {_time.time() - t_round:.1f}s)."
+        )
+        if added == 0:
+            log.emit(
+                f"Decomposition stalled at ε = {eps_dev:.2e} "
+                f"(integrality residual); falling back to stage CG."
+            )
+            break
+    if not decomposed and probs is not None:
+        # authoritative final check before falling back to stage CG
+        M = np.stack(comps, axis=0).astype(np.float64) / msize[None, :]
+        z, y, mu, probs = _stage_lp(np.ascontiguousarray(M.T), fixed, targets=v_relax)
+        lp_solves += 1
+        eps_dev = max(0.0, -z)
+        if eps_dev <= cfg.decomp_accept:
+            decomposed = True
+            log.emit(
+                f"Decomposition accepted at ε = {eps_dev:.2e} "
+                f"(≤ decomp_accept {cfg.decomp_accept:.0e})."
+            )
+    if decomposed:
+        fixed = v_relax
+        C = np.stack(comps, axis=0)
+        return TypeCGResult(
+            compositions=C,
+            probabilities=probs / probs.sum(),
+            type_values=fixed,
+            coverable=coverable,
+            stages=0,
+            lp_solves=lp_solves,
+            exact_prices=exact_prices,
+            eps_dev=eps_dev,
+        )
+
+    # ---- phase 2 (fallback): certified stage-wise column generation --------
+    pdhg_warm = None
     while (fixed < 0).any():
         stages += 1
+        # stage upper bound + targeted columns from the marginal LP relaxation
+        with log.timer("relaxation"):
+            z_ub, x_star = _relaxation_bound(reduction, fixed)
+            injected = 0
+            for c in _round_relaxation(x_star, reduction, rng):
+                injected += add_comp(c)
+        log.emit(
+            f"Stage {stages}: relaxation bound {z_ub:.6f}, injected {injected} "
+            f"rounded columns (portfolio {len(comps)})."
+        )
         while True:
             M = np.stack(comps, axis=0).astype(np.float64) / msize[None, :]
             MT = np.ascontiguousarray(M.T)
@@ -233,6 +561,21 @@ def leximin_cg_typespace(
                 else:
                     z, y, mu, probs = _stage_lp(MT, fixed)
             lp_solves += 1
+            if z >= z_ub - max(1e-7, 10 * _SLACK):
+                # master reached the relaxation bound: certified stage optimum
+                # (the integer hull is inside the relaxation polytope), no
+                # exact pricing needed
+                newly = (y > cfg.eps) & (fixed < 0)
+                if not newly.any():
+                    unfixed_idx = np.nonzero(fixed < 0)[0]
+                    newly = np.zeros(T, dtype=bool)
+                    newly[unfixed_idx[np.argmax(y[unfixed_idx])]] = True
+                fixed = np.where(newly, max(0.0, z), fixed)
+                log.emit(
+                    f"Stage {stages}: z={z:.6f} meets relaxation bound — fixed "
+                    f"{int(newly.sum())} type(s) ({int((fixed >= 0).sum())}/{T} done)."
+                )
+                break
             w_type = y / msize  # pricing weights per type
             # stochastic pricing: weight-steered batched panel draw
             key, sub = jax.random.split(key)
@@ -248,21 +591,32 @@ def leximin_cg_typespace(
             values = cand.astype(np.float64) @ w_type
             order = np.argsort(-values)
             added = 0
-            for i in order[: 4 * cfg.cg_columns_per_round]:
+            for i in order:
                 if values[i] <= -mu + cfg.eps:
                     break
                 if add_comp(cand[i]):
                     added += 1
-                    if added >= cfg.cg_columns_per_round:
+                    if added >= cfg.cg_columns_typespace:
                         break
-            if added:
-                continue
-            # certification: exact MILP pricing (leximin.py:420-431)
+            # exact pricing every iteration (as the reference does,
+            # leximin.py:420-424 — the MILP is ~40 ms in type space): its
+            # column is the single most violated constraint, which first-order
+            # sampling alone approaches only slowly
             with log.timer("exact_oracle"):
                 got = oracle.maximize(w_type)
             exact_prices += 1
             assert got is not None, "pricing MILP must stay feasible"
             best_comp, value = got
+            if value > -mu + cfg.eps and add_comp(best_comp):
+                added += 1
+            log.emit(
+                f"  stage {stages} iter {lp_solves}: z={z:.6f} cap={-mu:.6f} "
+                f"exact_best={value:.6f} "
+                f"best_sampled={values[order[0]] if len(values) else float('nan'):.6f} "
+                f"added {added} (portfolio {len(comps)})."
+            )
+            if added:
+                continue
             log.emit(
                 f"Stage {stages}: maximin ≤ {z + max(0.0, value + mu):.4%}, can do "
                 f"{z:.4%} with {len(comps)} compositions (gap {value + mu:.2e})."

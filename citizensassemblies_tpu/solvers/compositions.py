@@ -313,6 +313,7 @@ def decompose_with_pricing(
     support_eps: float = 1e-11,
     max_rounds: int = 200,
     log: Optional[RunLog] = None,
+    tol: float = 1e-9,
 ) -> Tuple[np.ndarray, np.ndarray, float]:
     """Exact panel decomposition of a composition distribution.
 
@@ -333,13 +334,14 @@ def decompose_with_pricing(
     members = reduction.members
     maxm = reduction.maxm
 
-    # seed: greedy water-filling decomposition — usually already exact, in
-    # which case no LP runs at all
+    # seed: greedy water-filling decomposition — usually already within
+    # tolerance, in which case no LP runs at all
+    tol = max(tol, 1e-9)
     P0, q0 = greedy_decompose(comps, probs, reduction, targets, support_eps=support_eps)
     total = q0.sum()
-    if abs(total - 1.0) < 1e-9:
+    if abs(total - 1.0) < tol:
         dev = float(np.max(targets - P0.T.astype(np.float64) @ q0))
-        if dev <= 1e-9:
+        if dev <= tol:
             return P0, q0 / total, max(dev, 0.0)
     rows: List[np.ndarray] = [r for r in P0]
     seen = {r.tobytes() for r in rows}
@@ -352,7 +354,7 @@ def decompose_with_pricing(
     for _ in range(max_rounds):
         P = np.stack(rows, axis=0)
         p, eps_dev, y, mu = solve_final_primal_lp_duals(P, targets)
-        if eps_dev <= 1e-9:
+        if eps_dev <= tol:
             break
         # price: value(c) = Σ_t (sum of the c_t largest y within type t)
         prefix = np.zeros((T, maxm + 1))

@@ -270,6 +270,8 @@ def solve_stage_lp_pdhg(
     fixed: np.ndarray,
     cfg: Optional[Config] = None,
     warm: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    targets: Optional[np.ndarray] = None,
+    tol: Optional[float] = None,
 ):
     """Type-space stage LP (max the min unfixed type value) on device.
 
@@ -279,18 +281,34 @@ def solve_stage_lp_pdhg(
     padded to a bucket (zero G/eq coefficients, zero cost — padding variables
     stay at 0) so the jitted PDHG core compiles once per bucket while the
     portfolio grows. Returns ``(z, y, mu, p, ok)`` plus the raw warm triple.
+
+    With ``targets`` given, every row becomes ``z + v_t − M_t·p ≤ 0``
+    (``fixed`` is ignored): maximize the uniform slack over per-type targets —
+    the decomposition feasibility LP, whose optimal downward deviation is
+    ``ε = max(0, −z*)``.
     """
     cfg = cfg or default_config()
     T, C = MT.shape
-    fixed = np.asarray(fixed, dtype=np.float64)
-    unfixed = fixed < 0
+    # ``z`` shares the x ≥ 0 cone; in targets mode the optimum may be
+    # negative (unrealizable targets), so optimize z̃ = z + shift instead
+    shift = 1.0 if targets is not None else 0.0
+    if targets is not None:
+        unfixed = np.ones(T, dtype=bool)
+        h_rows = shift - np.asarray(targets, dtype=np.float64) + 1e-9
+    else:
+        fixed = np.asarray(fixed, dtype=np.float64)
+        unfixed = fixed < 0
+        h_rows = np.where(unfixed, 0.0, -(np.maximum(fixed, 0.0) - 1e-9))
 
-    bucket = 512
+    # wide padding bucket: zero columns are free MXU work, while every bucket
+    # crossing costs a fresh jit of the PDHG core (~10 s) — with hundreds of
+    # columns added per round a narrow bucket recompiles nearly every round
+    bucket = 4096
     Cp = ((C + bucket - 1) // bucket) * bucket
     G = np.zeros((T, Cp + 1))
     G[:, :C] = -MT
     G[unfixed, Cp] = 1.0
-    h = np.where(unfixed, 0.0, -(np.maximum(fixed, 0.0) - 1e-9))
+    h = h_rows
     A = np.zeros((1, Cp + 1))
     A[0, :C] = 1.0
     b = np.array([1.0])
@@ -302,8 +320,8 @@ def solve_stage_lp_pdhg(
         x_w[:m] = warm[0][:m]
         x_w[Cp] = warm[0][-1]
         warm = (x_w, warm[1], warm[2])
-    sol = solve_lp(c, G, h, A, b, cfg=cfg, warm=warm)
-    z = float(sol.x[Cp])
+    sol = solve_lp(c, G, h, A, b, cfg=cfg, warm=warm, tol=tol)
+    z = float(sol.x[Cp]) - shift
     y = np.maximum(sol.lam, 0.0)
     mu = float(sol.mu[0])
     p = sol.x[:C]

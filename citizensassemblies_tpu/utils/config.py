@@ -55,6 +55,9 @@ class Config:
     seed_batch: int = 1_024
     #: violated columns added per dual LP solve.
     cg_columns_per_round: int = 16
+    #: violated compositions added per stage-LP solve in type-space CG (cheap
+    #: to carry: the stage LP has one row per type regardless of columns).
+    cg_columns_typespace: int = 512
     #: maximum committees held in the padded portfolio buffer (static shape).
     max_portfolio: int = 8_192
 
@@ -74,6 +77,22 @@ class Config:
     expand_budget: int = 4_096
     #: probe-LP tolerance certifying that a type cannot exceed the stage value.
     probe_tol: float = 1e-7
+    #: accept the relaxation-leximin profile when the decomposition LP
+    #: realizes it within this downward deviation (certifies exactness: the
+    #: relaxation dominates every achievable profile in leximin order).
+    decomp_tol: float = 1e-6
+    #: after the pricing rounds are exhausted, still accept the relaxation
+    #: profile when the residual is below this (well under the 1e-3 L∞
+    #: acceptance bar vs the reference's Gurobi allocations); only a larger
+    #: residual — a genuine integrality gap — falls back to stage CG.
+    decomp_accept: float = 1e-4
+    #: pricing rounds attempted for the decomposition before falling back to
+    #: stage-wise column generation.
+    decomp_max_rounds: int = 60
+    #: exact MILP pricing calls per decomposition round, at randomly perturbed
+    #: duals — each returns an extreme point of the composition polytope,
+    #: which grows the master's hull far faster than interior samples.
+    decomp_multicut: int = 32
 
     # --- XMIN -----------------------------------------------------------------
     #: portfolio-expansion iterations as a multiple of n (reference ``xmin.py:511``).
