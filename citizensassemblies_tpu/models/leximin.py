@@ -233,17 +233,27 @@ def _typespace_leximin(
         else:
             from citizensassemblies_tpu.solvers.compositions import decompose_with_pricing
 
+            # decompose toward the marginals the composition mixture actually
+            # realizes (within ts.eps_dev of the type values): the greedy
+            # water-filling is near-exact against those, whereas targeting
+            # the type values directly would leave the mixture's own ε as an
+            # unservable shortfall and push everything into the polish LPs
+            realized = ts.probabilities @ (
+                ts.compositions.astype(np.float64)
+                / reduction.msize.astype(np.float64)[None, :]
+            )
             P, probs, eps_dev = decompose_with_pricing(
                 ts.compositions,
                 ts.probabilities,
                 reduction,
-                fixed_agent,
+                realized[reduction.type_id],
                 budget=cfg.expand_budget,
                 support_eps=cfg.support_eps,
                 log=log,
-                # no point polishing the panel decomposition below the
-                # tolerance already accepted at the type level
-                tol=getattr(ts, "eps_dev", 0.0),
+                # enumerated path stays machine-exact; the CG path floors the
+                # panel tolerance at 2e-5 (its greedy noise scale) — total
+                # error ts.eps + 2e-5 stays far under the 1e-3 bar
+                tol=max(1e-9 if comps is not None else 2e-5, getattr(ts, "eps_dev", 0.0)),
             )
     probs = np.clip(probs, 0.0, 1.0)
     keep = probs > cfg.support_eps

@@ -236,6 +236,96 @@ def _leximin_relaxation(
     return fixed, x_last
 
 
+def _decomp_lp(MT: np.ndarray, v: np.ndarray) -> Tuple[float, np.ndarray, float, np.ndarray]:
+    """Two-sided decomposition master: ``min ε`` s.t.
+    ``v − ε ≤ M p ≤ v + ε``, ``Σp = 1``, ``p ≥ 0`` (host, sparse IPM).
+
+    One-sided feasibility (the reference's final-LP shape,
+    ``leximin.py:453-464``) lets the surplus ``Σ(alloc − v) = 0`` concentrate:
+    a deficit of ε per type funds an overshoot of up to T·ε on one type,
+    which breaks the L∞ acceptance bar even at small ε. The two-sided form
+    bounds the allocation error by ε directly. Returns ``(ε, w, μ, p)`` with
+    pricing weights ``w = y_lower − y_upper`` (mixed sign): a composition
+    improves the master iff ``w·(c/m) > −μ``.
+    """
+    T, C = MT.shape
+    v = np.asarray(v, dtype=np.float64)
+    G = scipy.sparse.vstack(
+        [
+            scipy.sparse.hstack(
+                [scipy.sparse.csr_matrix(-MT), scipy.sparse.csr_matrix(-np.ones((T, 1)))]
+            ),
+            scipy.sparse.hstack(
+                [scipy.sparse.csr_matrix(MT), scipy.sparse.csr_matrix(-np.ones((T, 1)))]
+            ),
+        ]
+    ).tocsr()
+    h = np.concatenate([-(v - _SLACK), v + _SLACK])
+    A_eq = scipy.sparse.csr_matrix(np.concatenate([np.ones(C), [0.0]])[None, :])
+    c_obj = np.zeros(C + 1)
+    c_obj[C] = 1.0
+    res = scipy.optimize.linprog(
+        c_obj, A_ub=G, b_ub=h, A_eq=A_eq, b_eq=[1.0],
+        bounds=[(0, None)] * (C + 1), method="highs-ipm",
+    )
+    if res.status != 0:
+        res = scipy.optimize.linprog(
+            c_obj, A_ub=G, b_ub=h, A_eq=A_eq, b_eq=[1.0],
+            bounds=[(0, None)] * (C + 1), method="highs",
+        )
+    if res.status != 0:
+        raise RuntimeError(f"decomposition LP failed: {res.message}")
+    lam = -np.asarray(res.ineqlin.marginals)  # ≥ 0
+    w = lam[:T] - lam[T:]
+    mu = float(res.eqlin.marginals[0])
+    return float(res.x[C]), w, mu, np.maximum(res.x[:C], 0.0)
+
+
+def solve_decomp_lp_pdhg(
+    MT: np.ndarray,
+    v: np.ndarray,
+    cfg: Optional[Config] = None,
+    warm=None,
+    tol: Optional[float] = None,
+):
+    """Device PDHG for the two-sided decomposition master (see
+    :func:`_decomp_lp`); loose-tolerance rounds guide pricing, the host IPM
+    stays authoritative near acceptance. Returns ``(ε, w, μ, p, ok, warm)``."""
+    from citizensassemblies_tpu.solvers.lp_pdhg import solve_lp
+
+    cfg = cfg or default_config()
+    T, C = MT.shape
+    v = np.asarray(v, dtype=np.float64)
+    bucket = 4096
+    Cp = ((C + bucket - 1) // bucket) * bucket
+    G = np.zeros((2 * T, Cp + 1))
+    G[:T, :C] = -MT
+    G[T:, :C] = MT
+    G[:, Cp] = -1.0
+    h = np.concatenate([-v, v])
+    A = np.zeros((1, Cp + 1))
+    A[0, :C] = 1.0
+    b = np.array([1.0])
+    c_obj = np.zeros(Cp + 1)
+    c_obj[Cp] = 1.0
+    if warm is not None and warm[0].shape[0] != Cp + 1:
+        x_w = np.zeros(Cp + 1)
+        m = min(C, warm[0].shape[0] - 1)
+        x_w[:m] = warm[0][:m]
+        x_w[Cp] = warm[0][-1]
+        warm = (x_w, warm[1], warm[2])
+    sol = solve_lp(c_obj, G, h, A, b, cfg=cfg, warm=warm, tol=tol)
+    w = sol.lam[:T] - sol.lam[T:]
+    return (
+        float(max(sol.x[Cp], 0.0)),
+        w,
+        float(sol.mu[0]),
+        sol.x[:C],
+        sol.ok,
+        (sol.x, sol.lam, sol.mu),
+    )
+
+
 @dataclasses.dataclass
 class TypeCGResult:
     compositions: np.ndarray  # int32 [C, T] generated portfolio
@@ -433,34 +523,24 @@ def leximin_cg_typespace(
             # IPM solve only when the estimate nears acceptance
             authoritative = not use_pdhg
             if use_pdhg:
-                from citizensassemblies_tpu.solvers.lp_pdhg import solve_stage_lp_pdhg
-
-                z, y, mu, probs, ok, pdhg_warm = solve_stage_lp_pdhg(
-                    MT, fixed, cfg=cfg, warm=pdhg_warm, targets=v_relax, tol=2e-5
+                eps_dev, w_dual, mu, probs, ok, pdhg_warm = solve_decomp_lp_pdhg(
+                    MT, v_relax, cfg=cfg, warm=pdhg_warm, tol=2e-5
                 )
-                if not ok or max(0.0, -z) <= 2.0 * cfg.decomp_accept:
+                if not ok or eps_dev <= 2.0 * cfg.decomp_accept:
                     authoritative = True
             if authoritative:
-                z, y, mu, probs = _stage_lp(MT, fixed, targets=v_relax)
+                eps_dev, w_dual, mu, probs = _decomp_lp(MT, v_relax)
         lp_solves += 1
-        eps_dev = max(0.0, -z)
         if authoritative and eps_dev <= cfg.decomp_accept:
             decomposed = True
             log.emit(
                 f"Decomposition: profile realized after {it + 1} round(s), "
-                f"ε = {eps_dev:.2e}, portfolio {len(comps)}."
-            )
-            break
-        if z >= -cfg.decomp_tol:
-            decomposed = True
-            log.emit(
-                f"Decomposition: relaxation profile realized after {it + 1} "
-                f"round(s), ε = {eps_dev:.2e}, portfolio {len(comps)}."
+                f"ε = {eps_dev:.2e} (two-sided), portfolio {len(comps)}."
             )
             break
         prune_columns(probs)
         # price toward the targets: stochastic draw + exact MILP + roundings
-        w_type = y / msize
+        w_type = w_dual / msize
         key, sub = jax.random.split(key)
         with log.timer("stochastic_pricing"):
             from citizensassemblies_tpu.solvers.pricing import _pricing_scores
@@ -487,10 +567,11 @@ def leximin_cg_typespace(
             if got is not None and got[1] > -mu + 1e-9 and add_comp(got[0]):
                 added += 1
             # multi-cut: extreme compositions at perturbed duals enlarge the
-            # master's hull much faster than interior samples
-            scale = float(np.mean(w_type[w_type > 0])) if (w_type > 0).any() else 1.0
+            # master's hull much faster than interior samples (weights are
+            # mixed-sign in the two-sided master — keep the signs)
+            scale = float(np.mean(np.abs(w_type))) + 1e-12
             for _ in range(cfg.decomp_multicut):
-                w_pert = np.maximum(w_type + rng.exponential(scale, T) * 0.5, 0.0)
+                w_pert = w_type + rng.normal(0.0, 0.5 * scale, T)
                 got_p = oracle.maximize(w_pert)
                 exact_prices += 1
                 if got_p is not None and add_comp(got_p[0]):
@@ -508,9 +589,8 @@ def leximin_cg_typespace(
     if not decomposed and probs is not None:
         # authoritative final check before falling back to stage CG
         M = np.stack(comps, axis=0).astype(np.float64) / msize[None, :]
-        z, y, mu, probs = _stage_lp(np.ascontiguousarray(M.T), fixed, targets=v_relax)
+        eps_dev, _, _, probs = _decomp_lp(np.ascontiguousarray(M.T), v_relax)
         lp_solves += 1
-        eps_dev = max(0.0, -z)
         if eps_dev <= cfg.decomp_accept:
             decomposed = True
             log.emit(

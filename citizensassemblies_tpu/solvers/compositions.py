@@ -340,7 +340,9 @@ def decompose_with_pricing(
     P0, q0 = greedy_decompose(comps, probs, reduction, targets, support_eps=support_eps)
     total = q0.sum()
     if abs(total - 1.0) < tol:
-        dev = float(np.max(targets - P0.T.astype(np.float64) @ q0))
+        # two-sided: overshoot counts too — mass conservation means a small
+        # one-sided deficit can fund a concentrated overshoot elsewhere
+        dev = float(np.max(np.abs(targets - P0.T.astype(np.float64) @ q0)))
         if dev <= tol:
             return P0, q0 / total, max(dev, 0.0)
     rows: List[np.ndarray] = [r for r in P0]

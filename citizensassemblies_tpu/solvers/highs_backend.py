@@ -21,6 +21,7 @@ import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+import scipy.sparse
 from scipy.optimize import Bounds, LinearConstraint, linprog, milp
 
 from citizensassemblies_tpu.core.instance import (
@@ -342,27 +343,47 @@ def solve_dual_lp(
 
 
 def solve_final_primal_lp_duals(
-    P: np.ndarray, target: np.ndarray
+    P: np.ndarray, target: np.ndarray, two_sided: bool = True
 ) -> Tuple[np.ndarray, float, np.ndarray, float]:
     """``solve_final_primal_lp`` variant also returning the dual solution:
-    ``(p, ε, y, μ)`` where ``y ≥ 0`` are the agent-coverage duals and ``μ`` the
+    ``(p, ε, y, μ)`` where ``y`` are the agent-coverage duals and ``μ`` the
     normalization dual — the quantities column-generation pricing needs
-    (reduced cost of a candidate panel column is ``−y·panel − μ``)."""
+    (reduced cost of a candidate panel column is ``−y·panel − μ``).
+
+    ``two_sided`` bounds the deviation on both sides
+    (``target − ε ≤ Pᵀp ≤ target + ε``): since panels conserve total mass
+    (``Σ alloc = k = Σ target``), a one-sided formulation lets a per-agent
+    deficit of ε fund an n·ε overshoot concentrated on one agent; the
+    two-sided ε bounds the allocation L∞ error directly. ``y`` is then the
+    mixed-sign ``y_lower − y_upper``.
+    """
     P = np.asarray(P, dtype=np.float64)
     C, n = P.shape
     target = np.asarray(target, dtype=np.float64)
     c = np.zeros(C + 1)
     c[-1] = 1.0
-    A_ub = np.hstack([-P.T, -np.ones((n, 1))])
-    b_ub = -target
+    lower = np.hstack([-P.T, -np.ones((n, 1))])
+    if two_sided:
+        A_ub = np.vstack([lower, np.hstack([P.T, -np.ones((n, 1))])])
+        b_ub = np.concatenate([-target, target])
+    else:
+        A_ub = lower
+        b_ub = -target
     A_eq = np.concatenate([np.ones(C), [0.0]])[None, :]
     b_eq = np.array([1.0])
     res = linprog(
-        c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=(0, None), method="highs"
+        c, A_ub=scipy.sparse.csr_matrix(A_ub), b_ub=b_ub, A_eq=A_eq, b_eq=b_eq,
+        bounds=(0, None), method="highs-ipm",
     )
     if res.status != 0 or res.x is None:
+        res = linprog(
+            c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=(0, None),
+            method="highs",
+        )
+    if res.status != 0 or res.x is None:
         raise SelectionError(f"final primal LP failed (HiGHS status {res.status}: {res.message})")
-    y = -np.asarray(res.ineqlin.marginals)
+    lam = -np.asarray(res.ineqlin.marginals)
+    y = lam[:n] - lam[n:] if two_sided else lam
     mu = float(res.eqlin.marginals[0])
     return res.x[:C], float(res.x[C]), y, mu
 
