@@ -65,7 +65,7 @@ def solve_final_primal_l2(
     minimal L2 norm (maximal spread). Returns (p, ε)."""
     from citizensassemblies_tpu.solvers.highs_backend import solve_final_primal_lp
 
-    _, eps_star = solve_final_primal_lp(P, target)
+    p_lp, eps_star = solve_final_primal_lp(P, target)
     eps = eps_star + eps_margin
 
     Pj = jnp.asarray(P, dtype=jnp.float32)
@@ -79,7 +79,27 @@ def solve_final_primal_l2(
     p = np.clip(p, 0.0, 1.0)
     s = p.sum()
     if s <= 0:
-        p = np.full(P.shape[0], 1.0 / P.shape[0])
+        p = np.asarray(p_lp, dtype=np.float64)
     else:
         p = p / s
+    # the f32 dual ascent converges to O(1e-3) residual; restore the exact ε
+    # floor by blending with the (feasible) LP solution — the largest convex
+    # weight on the spread iterate that keeps every agent above target − ε.
+    # Support stays the union of both supports, so the spread survives.
+    p_lp = np.clip(np.asarray(p_lp, dtype=np.float64), 0.0, 1.0)
+    p_lp = p_lp / p_lp.sum()
+    PT = P.T.astype(np.float64)
+    alloc_l2 = PT @ p
+    alloc_lp = PT @ p_lp
+    floor = np.asarray(target, dtype=np.float64) - eps
+    deficit = floor - alloc_l2  # > 0 where the ascent iterate undershoots
+    gain = alloc_lp - alloc_l2
+    mask = deficit > 0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratios = np.where(mask & (gain > 0), deficit / gain, np.nan)
+    beta = float(np.nanmax(ratios)) if np.isfinite(np.nanmax(ratios)) else (
+        1.0 if mask.any() else 0.0
+    )
+    beta = min(max(beta, 0.0), 1.0)
+    p = (1.0 - beta) * p + beta * p_lp
     return p, float(eps_star)
