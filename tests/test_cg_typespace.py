@@ -1,0 +1,120 @@
+"""Composition-space column generation (`solvers/cg_typespace.py`): oracle
+exactness, relaxation bounds, two-sided decomposition, and end-to-end
+equivalence with the enumerated type-space path."""
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import cross_product_instance, random_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.solvers.cg_typespace import (
+    CompositionOracle,
+    _decomp_lp,
+    _leximin_relaxation,
+    _relaxation_bound,
+    _round_relaxation,
+)
+from citizensassemblies_tpu.solvers.compositions import enumerate_compositions
+from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+from citizensassemblies_tpu.utils.config import default_config
+
+
+@pytest.fixture(scope="module")
+def midsize():
+    inst = random_instance(n=60, k=10, n_categories=2, features_per_category=3, seed=5)
+    dense, space = featurize(inst)
+    return dense, space, TypeReduction(dense)
+
+
+def test_oracle_matches_enumeration_max(midsize):
+    dense, _, red = midsize
+    comps = enumerate_compositions(red, cap=500_000)
+    assert comps is not None and len(comps)
+    oracle = CompositionOracle(red)
+    rng = np.random.default_rng(0)
+    M = comps.astype(float)
+    for _ in range(5):
+        w = rng.normal(size=red.T)  # mixed-sign weights (two-sided pricing)
+        comp, value = oracle.maximize(w)
+        brute = float((M @ w).max())
+        assert value == pytest.approx(brute, abs=1e-9)
+        assert comp.sum() == red.k
+
+
+def test_oracle_forced_type(midsize):
+    _, _, red = midsize
+    oracle = CompositionOracle(red)
+    for t in range(0, red.T, max(1, red.T // 5)):
+        got = oracle.maximize(np.zeros(red.T), forced_type=t)
+        if got is not None:
+            assert got[0][t] >= 1
+
+
+def test_relaxation_bound_dominates_compositions(midsize):
+    """Every integer composition lies inside the relaxation polytope, so the
+    stage bound must weakly exceed the best single-composition min value."""
+    _, _, red = midsize
+    comps = enumerate_compositions(red, cap=500_000)
+    z_ub, x_star = _relaxation_bound(red, np.full(red.T, -1.0))
+    m = red.msize.astype(float)
+    best_single = max(float((c / m).min()) for c in comps)
+    assert z_ub >= best_single - 1e-9
+    assert x_star.sum() == pytest.approx(red.k, abs=1e-6)
+
+
+def test_round_relaxation_feasible(midsize):
+    _, _, red = midsize
+    _, x_star = _relaxation_bound(red, np.full(red.T, -1.0))
+    rng = np.random.default_rng(1)
+    rounded = _round_relaxation(x_star, red, rng, count=64)
+    assert rounded, "at least some roundings must be quota-feasible"
+    tf = np.zeros((red.T, red.F), dtype=np.int64)
+    for t in range(red.T):
+        tf[t, red.type_feature[t]] = 1
+    for c in rounded:
+        assert c.sum() == red.k
+        counts = c @ tf
+        assert np.all(counts >= red.qmin) and np.all(counts <= red.qmax)
+
+
+def test_relaxation_leximin_matches_enumerated_values(midsize):
+    """On an instance where the relaxation profile is realizable, its leximin
+    values equal the enumerated (exact) type values."""
+    dense, space, red = midsize
+    v, _ = _leximin_relaxation(red, eps=5e-4)
+    dist = find_distribution_leximin(dense, space)  # enumerated path if viable
+    # per-type values from the exact run
+    got = np.array([dist.fixed_probabilities[red.members[t][0]] for t in range(red.T)])
+    assert np.max(np.abs(np.sort(v) - np.sort(got))) <= 5e-4 + 1e-6
+
+
+def test_decomp_lp_two_sided_bounds():
+    """The two-sided master's ε bounds max |Mp − v|, including overshoot."""
+    rng = np.random.default_rng(2)
+    T, C = 6, 40
+    comps = rng.integers(0, 4, size=(C, T)).astype(np.int32)
+    msize = np.full(T, 4.0)
+    M = comps / msize[None, :]
+    v = (np.full(C, 1.0 / C) @ M)  # realizable target
+    eps, w, mu, p = _decomp_lp(np.ascontiguousarray(M.T), v)
+    dev = np.max(np.abs(p @ M - v))
+    assert dev <= eps + 1e-6
+    assert eps <= 1e-6  # v is realizable by construction
+
+
+def test_cg_end_to_end_matches_enumeration():
+    inst = cross_product_instance(
+        ["g", "l"],
+        [["a", "b"], ["x", "y"]],
+        [[(4, 12), (4, 12)], [(2, 12), (2, 12)]],
+        [40, 5, 3, 12],
+        k=12,
+        name="skew",
+    )
+    dense, space = featurize(inst)
+    d_cg = find_distribution_leximin(
+        dense, space, cfg=default_config().replace(enum_max_types=0)
+    )
+    d_en = find_distribution_leximin(dense, space)
+    assert np.max(np.abs(d_cg.allocation - d_en.allocation)) <= 1e-4
