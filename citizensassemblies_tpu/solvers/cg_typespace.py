@@ -326,6 +326,57 @@ def solve_decomp_lp_pdhg(
     )
 
 
+def _slice_relaxation(
+    x: np.ndarray,
+    reduction: TypeReduction,
+    R: int = 512,
+) -> List[np.ndarray]:
+    """Systematic apportionment of a fractional marginal into ``R`` integer
+    compositions whose uniform mixture reproduces ``x`` to within ~1/R.
+
+    Slice j takes ``c_t(j) = ⌊j·x_t⌋ − ⌊(j−1)·x_t⌋`` (cumulative largest-
+    remainder rounding, so every type's total over slices is exact to ±1),
+    then repairs ``Σc = k`` by moving units between types with the smallest
+    rounding residuals, subject to the feature quotas. Slices that cannot be
+    repaired feasibly are dropped. Unlike independent randomized roundings
+    (≈5–20 % feasible on tight instances), these columns are *aimed*: their
+    hull surrounds ``x`` by construction, which is what the decomposition
+    master needs."""
+    T = reduction.T
+    k = reduction.k
+    lo, hi = reduction.qmin, reduction.qmax
+    tf = np.zeros((T, reduction.F), dtype=np.int64)
+    for t in range(T):
+        tf[t, reduction.type_feature[t]] = 1
+    x = np.asarray(x, dtype=np.float64)
+    prev = np.zeros(T, dtype=np.int64)
+    out: List[np.ndarray] = []
+    for j in range(1, R + 1):
+        cum = np.floor(j * x + 1e-12).astype(np.int64)
+        c = cum - prev
+        prev = cum
+        gap = k - int(c.sum())
+        if gap != 0:
+            # move units on the types closest to their next rounding boundary
+            frac = j * x - np.floor(j * x)
+            order = np.argsort(-frac) if gap > 0 else np.argsort(frac)
+            for t in order:
+                if gap == 0:
+                    break
+                if gap > 0 and c[t] < reduction.msize[t]:
+                    c[t] += 1
+                    gap -= 1
+                elif gap < 0 and c[t] > 0:
+                    c[t] -= 1
+                    gap += 1
+        if gap != 0:
+            continue
+        counts = c @ tf
+        if np.all(counts >= lo) and np.all(counts <= hi):
+            out.append(c.astype(np.int32))
+    return out
+
+
 @dataclasses.dataclass
 class TypeCGResult:
     compositions: np.ndarray  # int32 [C, T] generated portfolio
@@ -493,8 +544,12 @@ def leximin_cg_typespace(
     with log.timer("relax_leximin"):
         v_relax, x_star = _leximin_relaxation(reduction, cfg.eps, log)
         v_relax = np.where(coverable, v_relax, 0.0)
-        for c in _round_relaxation(x_star, reduction, rng, count=512):
-            add_comp(c)
+        injected = 0
+        for c in _slice_relaxation(x_star, reduction, R=1024):
+            injected += add_comp(c)
+        for c in _round_relaxation(x_star, reduction, rng, count=256):
+            injected += add_comp(c)
+        log.emit(f"Injected {injected} aimed columns around the relaxation optimum.")
     def prune_columns(p_now: np.ndarray, keep_last: int = 4000) -> None:
         """Column management: keep the LP support plus the freshest columns.
         Only as a memory backstop — every observed prune visibly slowed the

@@ -402,16 +402,26 @@ def solve_final_primal_lp(P: np.ndarray, target: np.ndarray) -> Tuple[np.ndarray
     P = np.asarray(P, dtype=np.float64)
     C, n = P.shape
     target = np.asarray(target, dtype=np.float64)
-    # variables [p_0..p_{C-1}, ε]
+    # variables [p_0..p_{C-1}, ε]; sparse (panel rows are k-of-n) + interior
+    # point — XMIN portfolios reach ~5n columns, where a dense simplex build
+    # takes minutes
     c = np.zeros(C + 1)
     c[-1] = 1.0
-    A_ub = np.hstack([-P.T, -np.ones((n, 1))])  # -(Pᵀp) - ε ≤ -target
+    A_ub = scipy.sparse.hstack(
+        [scipy.sparse.csr_matrix(-P.T), scipy.sparse.csr_matrix(-np.ones((n, 1)))]
+    ).tocsr()
     b_ub = -target
-    A_eq = np.concatenate([np.ones(C), [0.0]])[None, :]
+    A_eq = scipy.sparse.csr_matrix(np.concatenate([np.ones(C), [0.0]])[None, :])
     b_eq = np.array([1.0])
     res = linprog(
-        c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=(0, None), method="highs"
+        c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=(0, None),
+        method="highs-ipm",
     )
     if res.status != 0 or res.x is None:
+        res = linprog(
+            c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=(0, None),
+            method="highs",
+        )
+    if res.status != 0 or res.x is None:
         raise SelectionError(f"final primal LP failed (HiGHS status {res.status}: {res.message})")
-    return res.x[:C], float(res.x[C])
+    return res.x[:C], float(max(res.x[C], 0.0))
