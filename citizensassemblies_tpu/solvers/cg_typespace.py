@@ -349,29 +349,84 @@ def _slice_relaxation(
     for t in range(T):
         tf[t, reduction.type_feature[t]] = 1
     x = np.asarray(x, dtype=np.float64)
-    prev = np.zeros(T, dtype=np.int64)
+    msize = reduction.msize.astype(np.int64)
+    # cumulative feedback: each slice apportions the *residual* j·x −
+    # assigned, and every unit actually emitted (including quota repairs)
+    # feeds back into `assigned` — so repair deviations self-correct in later
+    # slices and the uniform mixture tracks x to ~1/R per type
+    assigned = np.zeros(T, dtype=np.int64)
     out: List[np.ndarray] = []
     for j in range(1, R + 1):
-        cum = np.floor(j * x + 1e-12).astype(np.int64)
-        c = cum - prev
-        prev = cum
+        need = j * x - assigned
+        c = np.maximum(np.floor(need + 1e-12), 0.0).astype(np.int64)
+        c = np.minimum(c, msize)
         gap = k - int(c.sum())
+        counts = c @ tf
         if gap != 0:
-            # move units on the types closest to their next rounding boundary
-            frac = j * x - np.floor(j * x)
+            # top up (or trim) the types with the largest (smallest)
+            # residual fraction, quota-aware; a per-slice golden-ratio
+            # jitter rotates exact ties
+            frac = need - np.floor(need + 1e-12)
+            jitter = ((np.arange(T) * 0.6180339887 + j * 0.7548776662) % 1.0) * 1e-6
+            frac = frac + jitter
             order = np.argsort(-frac) if gap > 0 else np.argsort(frac)
             for t in order:
                 if gap == 0:
                     break
-                if gap > 0 and c[t] < reduction.msize[t]:
+                row = tf[t]
+                if gap > 0 and c[t] < msize[t] and np.all(counts[row > 0] < hi[row > 0]):
                     c[t] += 1
+                    counts += row
                     gap -= 1
-                elif gap < 0 and c[t] > 0:
+                elif gap < 0 and c[t] > 0 and np.all(counts[row > 0] > lo[row > 0]):
                     c[t] -= 1
+                    counts -= row
                     gap += 1
         if gap != 0:
+            assigned += c  # feed back even on drop, keeping the stream honest
             continue
-        counts = c @ tf
+        # quota repair: unit swaps from a type in an over-full feature to a
+        # type in an under-full one (bounded effort; drop the slice if stuck)
+        for _ in range(3 * reduction.F):
+            over = np.nonzero(counts > hi)[0]
+            under = np.nonzero(counts < lo)[0]
+            if len(over) == 0 and len(under) == 0:
+                break
+            moved = False
+            donors = (
+                np.nonzero((tf[:, over[0]] > 0) & (c > 0))[0]
+                if len(over)
+                else np.nonzero(c > 0)[0]
+            )
+            receivers = (
+                np.nonzero((tf[:, under[0]] > 0) & (c < msize))[0]
+                if len(under)
+                else np.nonzero(c < msize)[0]
+            )
+            # rotate the starting point per slice for the same reason
+            if len(donors):
+                donors = np.roll(donors, -(j % len(donors)))
+            if len(receivers):
+                receivers = np.roll(receivers, -(j % len(receivers)))
+            for td in donors:
+                if moved:
+                    break
+                for tr in receivers:
+                    if td == tr:
+                        continue
+                    nc = counts - tf[td] + tf[tr]
+                    # the swap must strictly shrink the violation
+                    if np.sum(np.maximum(nc - hi, 0) + np.maximum(lo - nc, 0)) < np.sum(
+                        np.maximum(counts - hi, 0) + np.maximum(lo - counts, 0)
+                    ):
+                        c[td] -= 1
+                        c[tr] += 1
+                        counts = nc
+                        moved = True
+                        break
+            if not moved:
+                break
+        assigned += c
         if np.all(counts >= lo) and np.all(counts <= hi):
             out.append(c.astype(np.int32))
     return out
@@ -455,6 +510,8 @@ def leximin_cg_typespace(
     cfg: Optional[Config] = None,
     log: Optional[RunLog] = None,
     key=None,
+    checkpoint_path: Optional[str] = None,
+    households=None,
 ) -> TypeCGResult:
     """LEXIMIN via column generation over compositions.
 
@@ -493,30 +550,54 @@ def leximin_cg_typespace(
         np.add.at(out, (rows, tids.ravel()), 1)
         return out
 
-    # ---- seeding: one batched device draw + per-uncovered-type coverage ----
-    with log.timer("seed"):
-        key, sub = jax.random.split(key)
-        budget = max(256, min(cfg.mw_rounds_factor * T, cfg.seed_batch))
-        panels, ok = sample_panels_batch(dense, sub, budget)
-        panels = np.asarray(panels)
-        ok = np.asarray(ok)
-        for c in panels_to_comps(panels[ok]):
-            add_comp(c)
-        coverable = np.zeros(T, dtype=bool)
-        for c in comps:
-            coverable |= c > 0
-        log.emit(
-            f"Seeding: {len(comps)} distinct compositions from {int(ok.sum())} "
-            f"sampled panels, covering {int(coverable.sum())}/{T} types."
+    # checkpoint resume: restore the generated portfolio + targets so a
+    # preempted long decomposition continues from its last round (SURVEY §5 —
+    # the reference restarts 4,000 s runs from zero)
+    ckpt_fp = ""
+    resumed = None
+    if checkpoint_path is not None:
+        from citizensassemblies_tpu.utils.checkpoint import (
+            load_ts_state,
+            problem_fingerprint,
         )
-        for t in range(T):
-            if coverable[t]:
-                continue
-            got = oracle.maximize((~coverable).astype(np.float64), forced_type=t)
-            if got is None:
-                continue
-            add_comp(got[0])
-            coverable |= got[0] > 0
+
+        ckpt_fp = problem_fingerprint(dense, cfg, households)
+        resumed = load_ts_state(checkpoint_path, T, ckpt_fp)
+
+    # ---- seeding: one batched device draw + per-uncovered-type coverage ----
+    if resumed is None:
+        with log.timer("seed"):
+            key, sub = jax.random.split(key)
+            budget = max(256, min(cfg.mw_rounds_factor * T, cfg.seed_batch))
+            panels, ok = sample_panels_batch(dense, sub, budget)
+            panels = np.asarray(panels)
+            ok = np.asarray(ok)
+            for c in panels_to_comps(panels[ok]):
+                add_comp(c)
+            coverable = np.zeros(T, dtype=bool)
+            for c in comps:
+                coverable |= c > 0
+            log.emit(
+                f"Seeding: {len(comps)} distinct compositions from {int(ok.sum())} "
+                f"sampled panels, covering {int(coverable.sum())}/{T} types."
+            )
+            for t in range(T):
+                if coverable[t]:
+                    continue
+                got = oracle.maximize((~coverable).astype(np.float64), forced_type=t)
+                if got is None:
+                    continue
+                add_comp(got[0])
+                coverable |= got[0] > 0
+    else:
+        for c in resumed.compositions:
+            add_comp(c)
+        coverable = resumed.coverable.astype(bool)
+        key = jnp_key_from(resumed.key)
+        log.emit(
+            f"Resumed type-space checkpoint: {len(comps)} compositions, "
+            f"round {resumed.round}."
+        )
 
     fixed = np.full(T, -1.0)
     fixed[~coverable] = 0.0

@@ -109,3 +109,62 @@ def load_cg_state(
 
 def clear_cg_state(path: Union[str, Path]) -> None:
     Path(path).unlink(missing_ok=True)
+
+
+@dataclasses.dataclass
+class TypeCGState:
+    """Type-space column-generation state at a decomposition-round boundary
+    (the many-type LEXIMIN path, ``solvers/cg_typespace.py``)."""
+
+    compositions: np.ndarray  # int32[C, T]
+    v_relax: np.ndarray  # float64[T] relaxation-leximin targets
+    coverable: np.ndarray  # bool[T]
+    key: np.ndarray  # jax PRNGKey data
+    round: int = 0
+    fingerprint: str = ""
+
+
+def save_ts_state(path: Union[str, Path], state: TypeCGState) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(
+            fh,
+            kind=np.asarray([1], dtype=np.int8),  # distinguishes from CGState files
+            compositions=state.compositions.astype(np.int32),
+            v_relax=state.v_relax.astype(np.float64),
+            coverable=state.coverable.astype(bool),
+            key=np.asarray(state.key),
+            round=np.asarray([state.round], dtype=np.int64),
+            fingerprint=np.frombuffer(state.fingerprint.encode(), dtype=np.uint8),
+        )
+    os.replace(tmp, path)
+
+
+def load_ts_state(
+    path: Union[str, Path], T: int, fingerprint: str = ""
+) -> Optional[TypeCGState]:
+    path = Path(path)
+    if not path.exists():
+        return None
+    try:
+        with np.load(path) as z:
+            if "kind" not in z or "compositions" not in z:
+                return None
+            comps = z["compositions"]
+            if comps.ndim != 2 or comps.shape[1] != T:
+                return None
+            stored_fp = bytes(z["fingerprint"]).decode() if "fingerprint" in z else ""
+            if fingerprint and stored_fp != fingerprint:
+                return None
+            return TypeCGState(
+                compositions=comps.astype(np.int32),
+                v_relax=z["v_relax"],
+                coverable=z["coverable"],
+                key=z["key"],
+                round=int(z["round"][0]),
+                fingerprint=stored_fp,
+            )
+    except Exception:
+        return None
