@@ -167,6 +167,7 @@ def _typespace_leximin(
     cfg: Config,
     log: RunLog,
     final_stage: str,
+    checkpoint_path: Optional[str] = None,
 ) -> Optional[Distribution]:
     """Exact leximin in type space (see ``solvers/compositions.py``).
 
@@ -211,7 +212,11 @@ def _typespace_leximin(
             f"(enumeration over budget)."
         )
         with log.timer("typespace_cg"):
-            ts = leximin_cg_typespace(dense, reduction, cfg=cfg, log=log)
+            ts = leximin_cg_typespace(
+                dense, reduction, cfg=cfg, log=log, checkpoint_path=checkpoint_path
+            )
+        if checkpoint_path is not None:
+            clear_cg_state(checkpoint_path)
     fixed_agent = ts.type_values[reduction.type_id]
     # decompose into concrete panels matching the exact type targets: CG on
     # the final LP with closed-form pricing (top-c_t dual weights per type);
@@ -322,7 +327,7 @@ def find_distribution_leximin(
             is not None
         )
         if not has_ckpt:
-            dist = _typespace_leximin(dense, cfg, log, final_stage)
+            dist = _typespace_leximin(dense, cfg, log, final_stage, checkpoint_path)
             if dist is not None:
                 return dist
 

@@ -593,7 +593,7 @@ def leximin_cg_typespace(
         for c in resumed.compositions:
             add_comp(c)
         coverable = resumed.coverable.astype(bool)
-        key = jnp_key_from(resumed.key)
+        key = jax.numpy.asarray(resumed.key, dtype=jax.numpy.uint32)
         log.emit(
             f"Resumed type-space checkpoint: {len(comps)} compositions, "
             f"round {resumed.round}."
@@ -622,15 +622,20 @@ def leximin_cg_typespace(
     # integer compositions. Success (ε ≈ 0) certifies the true leximin without
     # any stage-wise column generation; an integrality residual falls back to
     # the certified stage loop below.
-    with log.timer("relax_leximin"):
-        v_relax, x_star = _leximin_relaxation(reduction, cfg.eps, log)
-        v_relax = np.where(coverable, v_relax, 0.0)
-        injected = 0
-        for c in _slice_relaxation(x_star, reduction, R=1024):
-            injected += add_comp(c)
-        for c in _round_relaxation(x_star, reduction, rng, count=256):
-            injected += add_comp(c)
-        log.emit(f"Injected {injected} aimed columns around the relaxation optimum.")
+    start_round = 0
+    if resumed is None:
+        with log.timer("relax_leximin"):
+            v_relax, x_star = _leximin_relaxation(reduction, cfg.eps, log)
+            v_relax = np.where(coverable, v_relax, 0.0)
+            injected = 0
+            for c in _slice_relaxation(x_star, reduction, R=1024):
+                injected += add_comp(c)
+            for c in _round_relaxation(x_star, reduction, rng, count=256):
+                injected += add_comp(c)
+            log.emit(f"Injected {injected} aimed columns around the relaxation optimum.")
+    else:
+        v_relax = resumed.v_relax
+        start_round = resumed.round
     def prune_columns(p_now: np.ndarray, keep_last: int = 4000) -> None:
         """Column management: keep the LP support plus the freshest columns.
         Only as a memory backstop — every observed prune visibly slowed the
@@ -649,8 +654,22 @@ def leximin_cg_typespace(
     decomposed = False
     import time as _time
 
-    for it in range(cfg.decomp_max_rounds):
+    for it in range(start_round, cfg.decomp_max_rounds):
         t_round = _time.time()
+        if checkpoint_path is not None and it > start_round:
+            from citizensassemblies_tpu.utils.checkpoint import TypeCGState, save_ts_state
+
+            save_ts_state(
+                checkpoint_path,
+                TypeCGState(
+                    compositions=np.stack(comps, axis=0),
+                    v_relax=v_relax,
+                    coverable=coverable,
+                    key=np.asarray(key),
+                    round=it,
+                    fingerprint=ckpt_fp,
+                ),
+            )
         M = np.stack(comps, axis=0).astype(np.float64) / msize[None, :]
         MT = np.ascontiguousarray(M.T)
         with log.timer("decomp_lp"):

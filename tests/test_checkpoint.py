@@ -119,3 +119,32 @@ def test_corrupt_checkpoint_ignored(small, tmp_path):
     assert load_cg_state(path, dense.n) is None
     dist = find_distribution_leximin(dense, space, checkpoint_path=str(path))
     assert abs(dist.allocation.sum() - dense.k) < 1e-3
+
+
+def test_typespace_state_roundtrip(tmp_path):
+    from citizensassemblies_tpu.utils.checkpoint import (
+        TypeCGState,
+        load_cg_state,
+        load_ts_state,
+        save_ts_state,
+    )
+
+    path = tmp_path / "ts.npz"
+    state = TypeCGState(
+        compositions=np.arange(12, dtype=np.int32).reshape(4, 3),
+        v_relax=np.array([0.1, 0.2, 0.3]),
+        coverable=np.array([True, True, False]),
+        key=np.array([0, 7], dtype=np.uint32),
+        round=5,
+        fingerprint="fp",
+    )
+    save_ts_state(path, state)
+    loaded = load_ts_state(path, T=3, fingerprint="fp")
+    assert loaded is not None and loaded.round == 5
+    np.testing.assert_array_equal(loaded.compositions, state.compositions)
+    np.testing.assert_array_equal(loaded.v_relax, state.v_relax)
+    # wrong type count or fingerprint ⇒ ignored
+    assert load_ts_state(path, T=4) is None
+    assert load_ts_state(path, T=3, fingerprint="other") is None
+    # the agent-space loader must not confuse a type-space file for its own
+    assert load_cg_state(path, n=3) is None
