@@ -124,6 +124,61 @@ def random_instance(
     )
 
 
+def skewed_instance(
+    n: int,
+    k: int,
+    n_categories: int,
+    features_per_category: Union[int, Sequence[int]] = 3,
+    seed: int = 0,
+    quota_slack: float = 0.12,
+    skew: float = 1.0,
+    name: str = "",
+) -> Instance:
+    """A heterogeneous-allocation instance: quotas target a Dirichlet
+    distribution *decoupled* from the pool composition.
+
+    ``random_instance`` brackets quotas around observed pool shares, which
+    makes the leximin allocation near-uniform (everyone ≈ k/n). Real pools are
+    self-selected while quotas mirror the population, so over-represented
+    groups get low selection probabilities — the reference's production
+    instances have LEXIMIN Gini 37–68 % (BASELINE.md). Here target shares are
+    drawn independently of the pool (blended with pool shares by ``skew``;
+    many fully skewed categories can be *jointly* infeasible) and repaired for
+    per-category feasibility, reproducing that heterogeneity.
+    """
+    rng = np.random.default_rng(seed)
+    base = random_instance(
+        n, k, n_categories, features_per_category, seed=seed, name=name or f"skewed_{n}_{k}"
+    )
+    cats: Dict[str, Dict[str, Quota]] = {}
+    for cat, feats in base.categories.items():
+        names = list(feats)
+        m = len(names)
+        pool = np.array(
+            [sum(1 for a in base.agents if a[cat] == f) for f in names], dtype=float
+        )
+        pool /= pool.sum()
+        target = (1.0 - skew) * pool + skew * rng.dirichlet([1.2] * m)
+        avail = {f: sum(1 for a in base.agents if a[cat] == f) for f in names}
+        lo = {}
+        hi = {}
+        for f, s in zip(names, target):
+            lo[f] = min(int(np.floor((1 - quota_slack) * s * k)), avail[f])
+            hi[f] = max(min(int(np.ceil((1 + quota_slack) * s * k)), avail[f]), lo[f])
+        while sum(lo.values()) > k:
+            f = max(lo, key=lambda x: lo[x])
+            lo[f] -= 1
+        while sum(hi.values()) < k:
+            f = max(names, key=lambda x: avail[x] - hi[x])
+            if avail[f] == hi[f]:
+                break
+            hi[f] += 1
+        cats[cat] = {f: (lo[f], hi[f]) for f in names}
+    import dataclasses
+
+    return dataclasses.replace(base, categories=cats)
+
+
 def sf_e_like_instance(seed: int = 0) -> Instance:
     """Synthetic stand-in for the withheld ``sf_e_110`` pool: n=1727, k=110,
     7 quota categories (shape from ``reference_output/sf_e_110_statistics.txt:2-5``)."""

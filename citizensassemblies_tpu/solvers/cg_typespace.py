@@ -654,8 +654,20 @@ def leximin_cg_typespace(
     decomposed = False
     import time as _time
 
+    eps_history: List[float] = []
     for it in range(start_round, cfg.decomp_max_rounds):
         t_round = _time.time()
+        if len(eps_history) >= 8 and eps_history[-1] > 10 * cfg.decomp_accept:
+            decay = eps_history[-1] / eps_history[-8]
+            if decay > 0.6:
+                # ≲6 %/round — the target sits on too many active floors for
+                # one-shot spanning; the stage loop (with its own per-stage
+                # aimed columns and bound certificates) closes faster
+                log.emit(
+                    f"Decomposition decaying slowly (ε={eps_history[-1]:.2e}, "
+                    f"×{decay:.2f}/8 rounds); switching to stage CG."
+                )
+                break
         if checkpoint_path is not None and it > start_round:
             from citizensassemblies_tpu.utils.checkpoint import TypeCGState, save_ts_state
 
@@ -686,6 +698,7 @@ def leximin_cg_typespace(
             if authoritative:
                 eps_dev, w_dual, mu, probs = _decomp_lp(MT, v_relax)
         lp_solves += 1
+        eps_history.append(eps_dev)
         if authoritative and eps_dev <= cfg.decomp_accept:
             decomposed = True
             log.emit(
@@ -774,11 +787,13 @@ def leximin_cg_typespace(
         with log.timer("relaxation"):
             z_ub, x_star = _relaxation_bound(reduction, fixed)
             injected = 0
+            for c in _slice_relaxation(x_star, reduction, R=384):
+                injected += add_comp(c)
             for c in _round_relaxation(x_star, reduction, rng):
                 injected += add_comp(c)
         log.emit(
             f"Stage {stages}: relaxation bound {z_ub:.6f}, injected {injected} "
-            f"rounded columns (portfolio {len(comps)})."
+            f"aimed columns (portfolio {len(comps)})."
         )
         while True:
             M = np.stack(comps, axis=0).astype(np.float64) / msize[None, :]

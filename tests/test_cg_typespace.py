@@ -118,3 +118,26 @@ def test_cg_end_to_end_matches_enumeration():
     )
     d_en = find_distribution_leximin(dense, space)
     assert np.max(np.abs(d_cg.allocation - d_en.allocation)) <= 1e-4
+
+
+def test_cg_heterogeneous_matches_enumeration():
+    """Skewed quotas (decoupled from pool shares) give a strongly
+    heterogeneous leximin profile — the multi-stage relaxation + decomposition
+    must still match the exact enumerated path."""
+    from citizensassemblies_tpu.core.generator import skewed_instance
+
+    inst = skewed_instance(n=80, k=14, n_categories=2, features_per_category=[3, 4], seed=3)
+    dense, space = featurize(inst)
+    d_en = find_distribution_leximin(
+        dense,
+        space,
+        cfg=default_config().replace(
+            enum_max_types=64, enum_cap=2_000_000, enum_node_budget=80_000_000
+        ),
+    )
+    d_cg = find_distribution_leximin(
+        dense, space, cfg=default_config().replace(enum_max_types=0)
+    )
+    spread = float(d_en.allocation.max() - d_en.allocation.min())
+    assert spread > 0.3, "instance must actually be heterogeneous"
+    assert np.max(np.abs(d_cg.allocation - d_en.allocation)) <= 1e-4
