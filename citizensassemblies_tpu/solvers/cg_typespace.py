@@ -811,6 +811,7 @@ def leximin_cg_typespace(
                 else:
                     z, y, mu, probs = _stage_lp(MT, fixed)
             lp_solves += 1
+            prune_columns(probs)
             if z >= z_ub - max(1e-7, 10 * _SLACK):
                 # master reached the relaxation bound: certified stage optimum
                 # (the integer hull is inside the relaxation polytope), no
