@@ -1,0 +1,90 @@
+"""Batched-instance sweep: vmap the panel sampler over many instances at once.
+
+SURVEY §7.7's batch-parallel axis: parameter studies run thousands of
+Monte-Carlo estimates over *different* pools (synthetic sweeps, bootstrap
+resamples, quota sensitivity scans). The reference would loop its sequential
+10,000-draw estimator per instance; here instances are padded to a common
+(n_max, F_max) shape and the whole sweep is one ``jax.vmap`` of the batched
+sampler — a single device program whose leading axis can further be sharded
+across a mesh with ``shard_map`` (``parallel/mc.py``).
+
+Padding is semantically inert by construction: padding agents have all-zero
+incidence rows, so they belong to no quota cell and can never be picked;
+padding features have ``qmax = 0``, so they are never eligible urgent cells
+and never constrain a draw (verified in ``tests/test_parallel.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import DenseInstance
+
+
+def pad_and_stack(denses: Sequence[DenseInstance]) -> Tuple[DenseInstance, np.ndarray]:
+    """Stack instances into one batched :class:`DenseInstance` pytree.
+
+    All instances must share ``k`` (vmap requires a common scan length).
+    Returns ``(batched, n_real int64[B])`` where ``batched.A`` is
+    ``bool[B, n_max, F_max]``.
+    """
+    ks = {d.k for d in denses}
+    if len(ks) != 1:
+        raise ValueError(f"sweep requires a common panel size k, got {sorted(ks)}")
+    n_max = max(d.n for d in denses)
+    f_max = max(d.n_features for d in denses)
+    A = np.zeros((len(denses), n_max, f_max), dtype=bool)
+    qmin = np.zeros((len(denses), f_max), dtype=np.int32)
+    qmax = np.zeros((len(denses), f_max), dtype=np.int32)
+    cat = np.zeros((len(denses), f_max), dtype=np.int32)
+    for i, d in enumerate(denses):
+        A[i, : d.n, : d.n_features] = np.asarray(d.A)
+        qmin[i, : d.n_features] = np.asarray(d.qmin)
+        qmax[i, : d.n_features] = np.asarray(d.qmax)
+        cat[i, : d.n_features] = np.asarray(d.cat_of_feature)
+    batched = DenseInstance(
+        A=jnp.asarray(A),
+        qmin=jnp.asarray(qmin),
+        qmax=jnp.asarray(qmax),
+        cat_of_feature=jnp.asarray(cat),
+        k=denses[0].k,
+        n_categories=max(d.n_categories for d in denses),
+    )
+    return batched, np.asarray([d.n for d in denses], dtype=np.int64)
+
+
+def sweep_legacy_allocations(
+    denses: Sequence[DenseInstance],
+    chains_per_instance: int = 1024,
+    seed: int = 0,
+    key=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """LEGACY Monte-Carlo allocations for every instance in one device call.
+
+    Returns ``(allocations float64[B, n_max], accept_rate float64[B])`` —
+    per-agent selection frequencies over the accepted chains of each
+    instance (padding agents report 0).
+    """
+    from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+
+    batched, n_real = pad_and_stack(denses)
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, len(denses))
+
+    def one(dense_i: DenseInstance, key_i):
+        panels, ok = _sample_panels_kernel(dense_i, key_i, chains_per_instance)
+        n_max = dense_i.A.shape[0]
+        onehot = jax.nn.one_hot(panels, n_max, dtype=jnp.float32)  # [B, k, n]
+        counts = jnp.einsum("bkn,b->n", onehot, ok.astype(jnp.float32))
+        denom = jnp.maximum(ok.sum(), 1)
+        return counts / denom, ok.mean()
+
+    # batch every array leaf; static fields (k, n_categories) ride along as aux
+    axes = jax.tree_util.tree_map(lambda _: 0, batched)
+    alloc, rate = jax.vmap(one, in_axes=(axes, 0))(batched, keys)
+    return np.asarray(alloc, dtype=np.float64), np.asarray(rate, dtype=np.float64)

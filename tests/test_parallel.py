@@ -74,3 +74,47 @@ def test_graft_dryrun_multichip():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_instance_sweep_matches_single_runs():
+    """vmap-over-instances sweep: padded batch reproduces each instance's own
+    MC allocation within Monte-Carlo tolerance; padding agents never appear."""
+    import jax
+
+    from citizensassemblies_tpu.core.generator import random_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+    from citizensassemblies_tpu.parallel.sweep import sweep_legacy_allocations
+
+    denses = []
+    for seed, n in ((0, 40), (1, 56), (2, 48)):
+        inst = random_instance(n=n, k=8, n_categories=2, features_per_category=2, seed=seed)
+        d, _ = featurize(inst)
+        denses.append(d)
+    alloc, rate = sweep_legacy_allocations(denses, chains_per_instance=2048, seed=7)
+    assert alloc.shape == (3, 56)
+    assert np.all(rate > 0.5)
+    for i, d in enumerate(denses):
+        # padding agents (beyond the instance's n) must never be selected
+        assert np.all(alloc[i, d.n :] == 0.0)
+        # per-instance single run agrees within MC noise
+        panels, ok = _sample_panels_kernel(d, jax.random.PRNGKey(100 + i), 2048)
+        panels, ok = np.asarray(panels), np.asarray(ok)
+        counts = np.zeros(d.n)
+        for row in panels[ok]:
+            counts[row] += 1
+        single = counts / max(ok.sum(), 1)
+        assert np.max(np.abs(single - alloc[i, : d.n])) < 0.08
+
+
+def test_instance_sweep_rejects_mixed_k():
+    import pytest as _pytest
+
+    from citizensassemblies_tpu.core.generator import random_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.parallel.sweep import pad_and_stack
+
+    d1, _ = featurize(random_instance(n=30, k=5, n_categories=2, seed=0))
+    d2, _ = featurize(random_instance(n=30, k=6, n_categories=2, seed=0))
+    with _pytest.raises(ValueError):
+        pad_and_stack([d1, d2])
