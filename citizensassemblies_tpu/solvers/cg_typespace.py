@@ -447,30 +447,22 @@ class TypeCGResult:
 def _stage_lp(
     MT: np.ndarray,
     fixed: np.ndarray,
-    targets: Optional[np.ndarray] = None,
 ) -> Tuple[float, np.ndarray, float, np.ndarray]:
     """Maximize the minimum unfixed type value over the portfolio.
 
     Returns ``(z*, y, mu, p)`` where ``y ≥ 0`` are per-unfixed-type duals
     (Σy = 1), ``mu`` the normalization dual — a candidate composition ``c``
     improves the stage iff ``Σ_t ŷ_t c_t/m_t > −mu`` with ``ŷ`` the full dual
-    vector (fixed types included). With ``targets`` given every row becomes
-    ``M_t·p ≥ v_t + z`` (the decomposition feasibility LP; ``ε = max(0, −z*)``).
+    vector (fixed types included).
     """
     T, C = MT.shape
-    if targets is not None:
-        unfixed = np.arange(T)
-        done = np.zeros(0, dtype=int)
-    else:
-        unfixed = np.nonzero(fixed < 0)[0]
-        done = np.nonzero(fixed >= 0)[0]
+    unfixed = np.nonzero(fixed < 0)[0]
+    done = np.nonzero(fixed >= 0)[0]
     nu, nd = len(unfixed), len(done)
     A_ub = np.zeros((nu + nd, C + 1))
     A_ub[:nu, :C] = -MT[unfixed]
     A_ub[:nu, C] = 1.0
     b_ub = np.zeros(nu + nd)
-    if targets is not None:
-        b_ub[:nu] = -(np.asarray(targets, dtype=np.float64) - _SLACK)
     if nd:
         A_ub[nu:, :C] = -MT[done]
         b_ub[nu:] = -(fixed[done] - _SLACK)

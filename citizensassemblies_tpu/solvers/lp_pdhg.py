@@ -270,7 +270,6 @@ def solve_stage_lp_pdhg(
     fixed: np.ndarray,
     cfg: Optional[Config] = None,
     warm: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
-    targets: Optional[np.ndarray] = None,
     tol: Optional[float] = None,
 ):
     """Type-space stage LP (max the min unfixed type value) on device.
@@ -281,24 +280,12 @@ def solve_stage_lp_pdhg(
     padded to a bucket (zero G/eq coefficients, zero cost — padding variables
     stay at 0) so the jitted PDHG core compiles once per bucket while the
     portfolio grows. Returns ``(z, y, mu, p, ok)`` plus the raw warm triple.
-
-    With ``targets`` given, every row becomes ``z + v_t − M_t·p ≤ 0``
-    (``fixed`` is ignored): maximize the uniform slack over per-type targets —
-    the decomposition feasibility LP, whose optimal downward deviation is
-    ``ε = max(0, −z*)``.
     """
     cfg = cfg or default_config()
     T, C = MT.shape
-    # ``z`` shares the x ≥ 0 cone; in targets mode the optimum may be
-    # negative (unrealizable targets), so optimize z̃ = z + shift instead
-    shift = 1.0 if targets is not None else 0.0
-    if targets is not None:
-        unfixed = np.ones(T, dtype=bool)
-        h_rows = shift - np.asarray(targets, dtype=np.float64) + 1e-9
-    else:
-        fixed = np.asarray(fixed, dtype=np.float64)
-        unfixed = fixed < 0
-        h_rows = np.where(unfixed, 0.0, -(np.maximum(fixed, 0.0) - 1e-9))
+    fixed = np.asarray(fixed, dtype=np.float64)
+    unfixed = fixed < 0
+    h_rows = np.where(unfixed, 0.0, -(np.maximum(fixed, 0.0) - 1e-9))
 
     # wide padding bucket: zero columns are free MXU work, while every bucket
     # crossing costs a fresh jit of the PDHG core (~10 s) — with hundreds of
@@ -321,7 +308,7 @@ def solve_stage_lp_pdhg(
         x_w[Cp] = warm[0][-1]
         warm = (x_w, warm[1], warm[2])
     sol = solve_lp(c, G, h, A, b, cfg=cfg, warm=warm, tol=tol)
-    z = float(sol.x[Cp]) - shift
+    z = float(sol.x[Cp])
     y = np.maximum(sol.lam, 0.0)
     mu = float(sol.mu[0])
     p = sol.x[:C]
