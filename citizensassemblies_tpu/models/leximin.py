@@ -200,7 +200,7 @@ def _typespace_leximin(
         )
         with log.timer("typespace_lp"):
             ts = leximin_over_compositions(
-                comps, reduction.msize, eps=cfg.eps, probe_tol=cfg.probe_tol, log=log
+                comps, reduction.msize, probe_tol=cfg.probe_tol, log=log
             )
     else:
         # too many types to enumerate: column generation over compositions,
@@ -252,7 +252,7 @@ def _typespace_leximin(
                 ts.probabilities,
                 reduction,
                 realized[reduction.type_id],
-                budget=cfg.expand_budget,
+                budget=cfg.decompose_budget,
                 support_eps=cfg.support_eps,
                 log=log,
                 # enumerated path stays machine-exact; the CG path floors the

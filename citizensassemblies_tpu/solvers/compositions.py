@@ -37,6 +37,7 @@ import numpy as np
 import scipy.optimize
 import scipy.sparse
 
+from citizensassemblies_tpu.solvers.lp_util import probe_confirm_tranche
 from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
 from citizensassemblies_tpu.utils.logging import RunLog
 
@@ -127,17 +128,14 @@ _SLACK = 1e-9  # constraint slack absorbing LP solver round-off
 
 
 def _linprog(c, A_ub, b_ub, A_eq, b_eq, bounds):
-    res = scipy.optimize.linprog(
-        c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=bounds,
-        method="highs",
-    )
-    return res
+    from citizensassemblies_tpu.solvers.lp_util import robust_linprog
+
+    return robust_linprog(c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq, bounds=bounds)
 
 
 def leximin_over_compositions(
     comps: np.ndarray,
     msize: np.ndarray,
-    eps: float = 5e-4,
     probe_tol: float = 1e-7,
     log: Optional[RunLog] = None,
 ) -> TypeLeximin:
@@ -145,10 +143,18 @@ def leximin_over_compositions(
 
     Runs the reference's outer fixing loop (``leximin.py:383-449``) with the
     portfolio replaced by *every* feasible composition, so no pricing is ever
-    needed: each stage is one LP (max the min unfixed type value), the tranche
-    is proposed by the dual weights and *confirmed* by per-type probe LPs, and
-    the final stage recovers composition probabilities minimizing the max
-    downward deviation ε (``leximin.py:453-464``).
+    needed: each stage is one LP (max the min unfixed type value), and the
+    final stage recovers composition probabilities minimizing the max downward
+    deviation ε (``leximin.py:453-464``).
+
+    Every fixed tranche is **probe-certified** against the stage's optimal
+    face: the dual-proposed candidates (``y > 0`` at a vertex optimum proves
+    tightness only at that one optimum) are confirmed by one group LP — if
+    ``max Σ_cand M_t·p`` over the face equals ``|cand|·z``, no candidate can
+    exceed ``z`` at any optimum — with per-candidate probes on disagreement;
+    the remaining near-zero-dual types are probed individually to catch
+    degenerately tight ones. The reference trusts the ``y > EPS`` heuristic
+    alone (``leximin.py:431-443``); here no tranche is ever fixed prematurely.
     """
     log = log or RunLog(echo=False)
     C, T = comps.shape
@@ -193,20 +199,46 @@ def leximin_over_compositions(
         z = float(res.x[C])
         y = -np.asarray(res.ineqlin.marginals[:nu])  # dual weights, ≥ 0
 
-        # tranche: dual weight > 0 certifies tightness on the whole optimal
-        # face (complementary slackness); probe-confirm the near-zero rest
-        tranche = np.zeros(len(unfixed), dtype=bool)
-        tranche[y > 1e-9] = True
-        for j in np.nonzero(~tranche)[0]:
-            t = unfixed[j]
-            # probe: max M_t·p subject to every unfixed type ≥ z, fixed ≥ f
-            A_p = np.concatenate([-MT[unfixed], -MT[done]], axis=0) if nd else -MT[unfixed]
-            b_p = np.concatenate(
-                [np.full(nu, -(z - _SLACK)), -(fixed[done] - _SLACK)]
-            ) if nd else np.full(nu, -(z - _SLACK))
-            res_p = _linprog(-MT[t], A_p, b_p, np.ones((1, C)), [1.0], [(0, None)] * C)
+        # optimal-face constraints, hoisted: every unfixed type ≥ z, fixed ≥ f
+        # (only the probe objective row changes per candidate)
+        A_p = np.concatenate([-MT[unfixed], -MT[done]], axis=0) if nd else -MT[unfixed]
+        b_p = np.concatenate(
+            [np.full(nu, -(z - _SLACK)), -(fixed[done] - _SLACK)]
+        ) if nd else np.full(nu, -(z - _SLACK))
+        A_eq_p = np.ones((1, C))
+        bounds_p = [(0, None)] * C
+
+        def face_max(obj_rows: np.ndarray) -> Optional[float]:
+            nonlocal lp_solves
+            r = _linprog(-obj_rows, A_p, b_p, A_eq_p, [1.0], bounds_p)
             lp_solves += 1
-            if res_p.status != 0 or -res_p.fun <= z + probe_tol:
+            return None if r is None or r.status != 0 else float(-r.fun)
+
+        # tranche candidates from the duals, probe-certified via the shared
+        # group-then-individual scheme (lp_util.probe_confirm_tranche). The
+        # face floors are each relaxed by _SLACK in normalized units — i.e.
+        # _SLACK·m_u raw members — and at most that freed mass can be
+        # re-routed into a candidate, so tightness is judged up to
+        # _SLACK·Σm/m_t or genuinely tight types probe "loose" on large pools
+        msz = np.asarray(msize, dtype=np.float64)
+        slack_gain = _SLACK * float(msz.sum())
+        tranche = np.zeros(nu, dtype=bool)
+        cand = np.nonzero(y > 1e-9)[0]
+        if len(cand):
+            conf = probe_confirm_tranche(
+                face_max, MT[unfixed[cand]], z, probe_tol,
+                slack_gain / msz[unfixed[cand]],
+            )
+            tranche[cand[conf]] = True
+        # near-zero dual weight can still be degenerately tight everywhere —
+        # but a type already above z at *this* optimum provably is not, so
+        # only the ones sitting at z need a probe
+        vals = MT[unfixed] @ np.maximum(res.x[:C], 0.0)
+        for j in np.nonzero((y <= 1e-9) & (vals <= z + probe_tol))[0]:
+            got = face_max(MT[unfixed[j]])
+            if got is None or got <= z + probe_tol + slack_gain / float(
+                msz[unfixed[j]]
+            ):
                 tranche[j] = True
         if not tranche.any():
             tranche[np.argmax(y)] = True  # progress guard
@@ -309,13 +341,17 @@ def decompose_with_pricing(
     probs: np.ndarray,
     reduction: TypeReduction,
     targets: np.ndarray,
-    budget: int = 1024,
+    budget: int = 16_384,
     support_eps: float = 1e-11,
     max_rounds: int = 200,
     log: Optional[RunLog] = None,
     tol: float = 1e-9,
 ) -> Tuple[np.ndarray, np.ndarray, float]:
     """Exact panel decomposition of a composition distribution.
+
+    ``budget`` bounds the panel portfolio the greedy water-filling seed may
+    emit; any mass it could not serve within the budget is recovered by the
+    pricing LP loop below.
 
     Finds concrete panels and probabilities whose per-agent allocation matches
     ``targets`` up to LP tolerance, via column generation on the final LP
@@ -337,7 +373,9 @@ def decompose_with_pricing(
     # seed: greedy water-filling decomposition — usually already within
     # tolerance, in which case no LP runs at all
     tol = max(tol, 1e-9)
-    P0, q0 = greedy_decompose(comps, probs, reduction, targets, support_eps=support_eps)
+    P0, q0 = greedy_decompose(
+        comps, probs, reduction, targets, support_eps=support_eps, max_panels=budget
+    )
     total = q0.sum()
     if abs(total - 1.0) < tol:
         # two-sided: overshoot counts too — mass conservation means a small

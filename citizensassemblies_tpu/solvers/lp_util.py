@@ -1,0 +1,87 @@
+"""Shared host-LP plumbing for the type-space solvers.
+
+scipy's HiGHS front-end occasionally declares *feasible* LPs infeasible when
+presolve encounters rows that are tight to within its tolerance — observed on
+leximin stage LPs whose fixed-type floors sit 1e-9 below an attained optimum
+(the witness point violated no constraint by more than 2e-14 yet both
+``method="highs"`` and ``"highs-ipm"`` reported infeasibility; re-solving with
+``presolve=False`` found the optimum). :func:`robust_linprog` retries across
+presolve settings and methods before giving up, so borderline-degenerate
+stages never abort an otherwise-exact solve.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import scipy.optimize
+
+
+def robust_linprog(
+    c,
+    A_ub=None,
+    b_ub=None,
+    A_eq=None,
+    b_eq=None,
+    bounds=None,
+    methods: Sequence[str] = ("highs", "highs-ipm"),
+) -> scipy.optimize.OptimizeResult:
+    """``scipy.optimize.linprog`` with a presolve/method retry ladder.
+
+    Tries each method with presolve on, then off; returns the first optimal
+    result, else the last attempt (caller checks ``res.status``).
+    """
+    assert methods, "need at least one LP method"
+    last = None
+    for method in methods:
+        for presolve in (True, False):
+            res = scipy.optimize.linprog(
+                c,
+                A_ub=A_ub,
+                b_ub=b_ub,
+                A_eq=A_eq,
+                b_eq=b_eq,
+                bounds=bounds,
+                method=method,
+                options=None if presolve else {"presolve": False},
+            )
+            if res.status == 0:
+                return res
+            last = res
+    return last
+
+
+def probe_confirm_tranche(
+    face_max: Callable[[np.ndarray], Optional[float]],
+    objectives: np.ndarray,
+    z: float,
+    probe_tol: float,
+    allowances: np.ndarray,
+) -> np.ndarray:
+    """Certify which leximin tranche candidates are capped at ``z`` over a
+    stage's optimal face.
+
+    ``face_max(w)`` maximizes ``w`` over the face (every candidate's own value
+    is ≥ z there); ``objectives[i]`` is candidate i's value functional;
+    ``allowances[i]`` bounds the spurious headroom constraint slack can grant
+    candidate i (see the callers' slack-gain derivations). One group LP over
+    ``Σ objectives`` certifies every candidate at once when its optimum is
+    ``|cand|·z`` up to one shared tolerance — since each term is ≥ z on the
+    face, a sum bound of ``n·z + δ`` caps every single term at ``z + δ``;
+    per-candidate probes resolve disagreement. Returns a bool mask.
+    """
+    n = len(objectives)
+    confirmed = np.zeros(n, dtype=bool)
+    if n == 0:
+        return confirmed
+    allowances = np.asarray(allowances, dtype=np.float64)
+    got = face_max(np.sum(objectives, axis=0))
+    if got is not None and got <= n * z + probe_tol + float(allowances.min()):
+        confirmed[:] = True
+        return confirmed
+    for i in range(n):
+        got = face_max(objectives[i])
+        if got is not None and got <= z + probe_tol + float(allowances[i]):
+            confirmed[i] = True
+    return confirmed

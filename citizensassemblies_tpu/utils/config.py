@@ -75,6 +75,10 @@ class Config:
     #: panels (bounds both the portfolio size and, on the equidistributed
     #: path, the per-composition allocation error ≈ 1/expand_budget).
     expand_budget: int = 4_096
+    #: panel cap for the greedy water-filling seed of the exact panel
+    #: decomposition (``decompose_with_pricing``); mass unserved within the
+    #: budget is recovered by its pricing-LP loop.
+    decompose_budget: int = 16_384
     #: probe-LP tolerance certifying that a type cannot exceed the stage value.
     probe_tol: float = 1e-7
     #: accept the relaxation-leximin profile when the decomposition LP

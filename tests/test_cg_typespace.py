@@ -82,7 +82,7 @@ def test_relaxation_leximin_matches_enumerated_values(midsize):
     """On an instance where the relaxation profile is realizable, its leximin
     values equal the enumerated (exact) type values."""
     dense, space, red = midsize
-    v, _ = _leximin_relaxation(red, eps=5e-4)
+    v, _ = _leximin_relaxation(red)
     dist = find_distribution_leximin(dense, space)  # enumerated path if viable
     # per-type values from the exact run
     got = np.array([dist.fixed_probabilities[red.members[t][0]] for t in range(red.T)])
