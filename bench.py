@@ -47,8 +47,9 @@ BASELINES = {
     "example_large_200_like": 1161.8,
     "example_small_like_20": 2.7,
     # north-star instance (reference_output/sf_e_110_statistics.txt:22); the
-    # real pool is withheld, the synthetic stand-in matches its shape
+    # real pool is withheld, the synthetic stand-ins match its shape
     "sf_e_like_110": 4011.6,
+    "sf_e_skewed_110": 4011.6,
 }
 
 
@@ -86,20 +87,37 @@ def main() -> None:
         "speedup": round(baseline / max(elapsed, 1e-9), 1),
     }
     if os.environ.get("BENCH_SKIP_SFE", "") != "1":
-        sfe_dense, sfe_space = featurize(sf_e_like_instance())
-        t0 = time.time()
-        sfe = find_distribution_leximin(sfe_dense, sfe_space)
-        sfe_elapsed = time.time() - t0
-        dev = float(
-            abs(sfe.allocation - sfe.fixed_probabilities).max()
-        )
-        detail["sf_e_like"] = {
-            "seconds": round(sfe_elapsed, 1),
-            "baseline_s": BASELINES["sf_e_like_110"],
-            "speedup": round(BASELINES["sf_e_like_110"] / max(sfe_elapsed, 1e-9), 1),
-            "alloc_linf_dev": round(dev, 8),
-            "min_prob": round(float(sfe.allocation.min()), 6),
-        }
+        # PRIMARY sf_e-class metric: the *heterogeneous* (skewed-quota) regime
+        # matching the real sf_e_110 allocation profile (Gini ≈ 0.5, min well
+        # below k/n — reference_output/sf_e_110_statistics.txt:6-11), not the
+        # structurally easier pool-proportional regime. `alloc_linf_dev` is
+        # the deviation from the probe-certified relaxation-leximin profile —
+        # an upper bound in leximin order computed independently of the
+        # decomposition that produced the allocation, so realizing it within
+        # ε certifies the allocation is the true leximin to that tolerance.
+        from citizensassemblies_tpu.core.generator import sf_e_skewed_instance
+
+        for name, builder in (
+            ("sf_e_skewed", sf_e_skewed_instance),
+            ("sf_e_like", sf_e_like_instance),
+        ):
+            sfe_dense, sfe_space = featurize(builder())
+            t0 = time.time()
+            sfe = find_distribution_leximin(sfe_dense, sfe_space)
+            sfe_elapsed = time.time() - t0
+            dev = float(abs(sfe.allocation - sfe.fixed_probabilities).max())
+            sfe_stats = prob_allocation_stats(
+                sfe.allocation, cap_for_geometric_mean=False
+            )
+            base_key = f"{name}_110"
+            detail[name] = {
+                "seconds": round(sfe_elapsed, 1),
+                "baseline_s": BASELINES[base_key],
+                "speedup": round(BASELINES[base_key] / max(sfe_elapsed, 1e-9), 1),
+                "alloc_linf_dev": round(dev, 8),
+                "min_prob": round(float(sfe.allocation.min()), 6),
+                "gini": round(sfe_stats.gini, 4),
+            }
 
     print(
         json.dumps(

@@ -176,7 +176,52 @@ def skewed_instance(
         cats[cat] = {f: (lo[f], hi[f]) for f in names}
     import dataclasses
 
-    return dataclasses.replace(base, categories=cats)
+    inst = dataclasses.replace(base, categories=cats)
+
+    # Per-category repair does not imply joint feasibility: with many fully
+    # skewed categories no single panel may satisfy every quota at once (all
+    # tested n=1727/7-category draws were jointly infeasible). Real instances
+    # are feasible because organizers relax quotas until a panel exists — do
+    # the same with the framework's own minimal-relaxation MILP (the
+    # reference's 1+2/q cost model, ``leximin.py:90-187``), which preserves
+    # the heterogeneous structure while guaranteeing feasibility.
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.solvers.cg_typespace import CompositionOracle
+    from citizensassemblies_tpu.solvers.highs_backend import relax_infeasible_quotas
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+    dense, space = featurize(inst)
+    red = TypeReduction(dense)
+    if CompositionOracle(red).maximize(np.zeros(red.T)) is None:
+        suggested, _ = relax_infeasible_quotas(dense, space)
+        repaired = {
+            cat: {f: suggested[(cat, f)] for f in feats}
+            for cat, feats in inst.categories.items()
+        }
+        inst = dataclasses.replace(inst, categories=repaired)
+    return inst
+
+
+def sf_e_skewed_instance(seed: int = 0) -> Instance:
+    """Heterogeneous synthetic stand-in for the withheld ``sf_e_110`` pool in
+    its *realistic* allocation regime.
+
+    Shape from ``reference_output/sf_e_110_statistics.txt:2-5`` (n=1727,
+    k=110, 7 categories); ``skew=0.4`` tuned so the exact leximin profile
+    lands in the band of the real instance — Gini ≈ 0.5 with the minimum
+    probability around 0.5·k/n (the reference reports Gini 51.2 %, min 2.6 %
+    vs k/n 6.4 %, lines 6-11) — unlike :func:`sf_e_like_instance`, whose
+    pool-proportional quotas make leximin collapse to the uniform k/n.
+    """
+    return skewed_instance(
+        n=1727,
+        k=110,
+        n_categories=7,
+        features_per_category=[2, 4, 5, 3, 2, 4, 6],
+        seed=seed,
+        skew=0.4,
+        name="sf_e_skewed_110",
+    )
 
 
 def sf_e_like_instance(seed: int = 0) -> Instance:

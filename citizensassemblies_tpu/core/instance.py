@@ -101,6 +101,39 @@ class SelectionError(Exception):
         super().__init__(message)
 
 
+class HostView:
+    """Host-side numpy mirror of a :class:`DenseInstance`'s arrays.
+
+    The host LP/MILP solvers (HiGHS, the type reduction, the native B&B
+    oracle) need plain numpy; pulling the device arrays back with
+    ``np.asarray(dense.A)`` costs a device→host transfer that can take
+    *minutes* through a TPU tunnel. ``featurize`` stores the originals here
+    instead. Carried as a static (non-pytree) field, so hash/eq are by
+    content — jit caching keys stay stable across re-featurizations of the
+    same instance.
+    """
+
+    __slots__ = ("A", "qmin", "qmax", "_h")
+
+    def __init__(self, A: np.ndarray, qmin: np.ndarray, qmax: np.ndarray):
+        self.A = A
+        self.qmin = qmin
+        self.qmax = qmax
+        self._h = hash((A.shape, A.tobytes(), qmin.tobytes(), qmax.tobytes()))
+
+    def __hash__(self) -> int:
+        return self._h
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, HostView)
+            and self._h == other._h
+            and np.array_equal(self.A, other.A)
+            and np.array_equal(self.qmin, other.qmin)
+            and np.array_equal(self.qmax, other.qmax)
+        )
+
+
 @struct.dataclass
 class DenseInstance:
     """Device-side dense instance pytree.
@@ -112,6 +145,7 @@ class DenseInstance:
       cat_of_feature: int32[F] category index per flat cell.
       k: static panel size.
       n_categories: static number of categories.
+      host: optional host-side numpy mirror (see :class:`HostView`).
     """
 
     A: jnp.ndarray
@@ -120,6 +154,7 @@ class DenseInstance:
     cat_of_feature: jnp.ndarray
     k: int = struct.field(pytree_node=False)
     n_categories: int = struct.field(pytree_node=False)
+    host: Optional[HostView] = struct.field(pytree_node=False, default=None)
 
     @property
     def n(self) -> int:
@@ -128,6 +163,19 @@ class DenseInstance:
     @property
     def n_features(self) -> int:
         return self.A.shape[1]
+
+    @property
+    def A_np(self) -> np.ndarray:
+        """bool[n, F] incidence on host (no device pull when mirrored)."""
+        return self.host.A if self.host is not None else np.asarray(self.A)
+
+    @property
+    def qmin_np(self) -> np.ndarray:
+        return self.host.qmin if self.host is not None else np.asarray(self.qmin)
+
+    @property
+    def qmax_np(self) -> np.ndarray:
+        return self.host.qmax if self.host is not None else np.asarray(self.qmax)
 
 
 def read_instance(
@@ -217,6 +265,8 @@ def featurize(instance: Instance) -> Tuple[DenseInstance, FeatureSpace]:
         for cat in cat_names:
             A[i, cell_index[(cat, agent[cat])]] = True
 
+    qmin_np = np.asarray(qmin, dtype=np.int32)
+    qmax_np = np.asarray(qmax, dtype=np.int32)
     dense = DenseInstance(
         A=jnp.asarray(A),
         qmin=jnp.asarray(qmin, dtype=jnp.int32),
@@ -224,6 +274,7 @@ def featurize(instance: Instance) -> Tuple[DenseInstance, FeatureSpace]:
         cat_of_feature=jnp.asarray(cat_of_feature, dtype=jnp.int32),
         k=instance.k,
         n_categories=len(cat_names),
+        host=HostView(A, qmin_np, qmax_np),
     )
     space = FeatureSpace(categories=tuple(cat_names), cells=tuple(cells))
     return dense, space

@@ -266,11 +266,11 @@ def _padded_constants(dense):
     n, F = dense.n, dense.n_features
     n_pad, F_pad, _ = _pads(dense)
     A = np.zeros((n_pad, F_pad), dtype=np.float32)
-    A[:n, :F] = np.asarray(dense.A, dtype=np.float32)
+    A[:n, :F] = dense.A_np.astype(np.float32)
     qmin = np.zeros((1, F_pad), dtype=np.float32)
-    qmin[0, :F] = np.asarray(dense.qmin, dtype=np.float32)
+    qmin[0, :F] = dense.qmin_np.astype(np.float32)
     qmax = np.zeros((1, F_pad), dtype=np.float32)
-    qmax[0, :F] = np.asarray(dense.qmax, dtype=np.float32)
+    qmax[0, :F] = dense.qmax_np.astype(np.float32)
     out = (jnp.asarray(A), jnp.asarray(A.T.copy()), jnp.asarray(qmin), jnp.asarray(qmax))
     while len(_PAD_CACHE) >= _PAD_CACHE_CAP:
         _PAD_CACHE.popitem(last=False)

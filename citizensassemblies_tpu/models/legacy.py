@@ -53,7 +53,7 @@ class LegacyResult:
     draws_attempted: int = 0
 
 
-def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, key, scores, households):
+def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, noise, scores, households):
     """One greedy selection step for a whole batch of chains.
 
     ``scores`` biases the within-cell member choice: the member picked is
@@ -87,7 +87,6 @@ def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, key, scores, households):
     cell = jnp.argmax(ratio, axis=1)  # [B]
 
     members = alive & (A_T_f32 > 0.5)[cell]  # [B,n]: alive agents in each chain's cell
-    noise = jax.random.gumbel(key, (B, n), dtype=jnp.float32)
     person = jnp.argmax(jnp.where(members, scores + noise, NEG_INF), axis=1)  # [B]
 
     person_feats = A_f32[person].astype(jnp.int32)  # [B,F] one-hot per category
@@ -105,14 +104,31 @@ def _sample_step(A_f32, A_T_f32, qmin, qmax, n, state, key, scores, households):
     return (alive, selected, failed), person
 
 
+def chain_keys_for(key, start: int, count: int) -> jnp.ndarray:
+    """Per-chain PRNG keys derived from *global* chain ids by ``fold_in``.
+
+    Chain ``start + i`` always gets the same key regardless of how chains are
+    batched or sharded, so a draw of N chains is bit-identical whether it runs
+    on one device or split across a mesh — the property the 1-vs-8-device
+    estimator test pins down.
+    """
+    ids = jnp.arange(start, start + count, dtype=jnp.uint32)
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(ids)
+
+
 @partial(jax.jit, static_argnames=("B",))
-def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None, households=None):
+def _sample_panels_kernel(
+    dense: DenseInstance, key, B: int, scores=None, households=None, chain_keys=None
+):
     """Draw B panels in parallel; returns (panels int32[B,k], ok bool[B]).
 
     ``scores`` is an optional [B, n] (or broadcastable) member-pick bias; see
     :func:`_sample_step`. ``None`` means uniform picks (plain LEGACY).
     ``households`` is an optional int32[n] group-id vector enabling the
     reference's ``check_same_address`` behavior (``legacy.py:78-99``).
+    ``chain_keys`` overrides the per-chain key derivation (shape [B] of key
+    data) — the distributed path passes each device its slice of the global
+    :func:`chain_keys_for` stream so results are device-count-invariant.
     """
     n, F, k = dense.n, dense.n_features, dense.k
     A_f32 = dense.A.astype(jnp.float32)
@@ -124,27 +140,35 @@ def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None, househ
         households = jnp.arange(n, dtype=jnp.int32)
     else:
         households = jnp.asarray(households, dtype=jnp.int32)
+    if chain_keys is None:
+        chain_keys = chain_keys_for(key, 0, B)
 
     alive0 = jnp.ones((B, n), dtype=bool)
     selected0 = jnp.zeros((B, F), dtype=jnp.int32)
     failed0 = jnp.zeros((B,), dtype=bool)
-    step_keys = jax.random.split(key, k)
 
-    def body(state, step_key):
+    def body(state, step):
         alive, selected, failed = state
         # "run out of people" before the final pick fails the draw
         # (legacy.py:198-199); checked as part of starvation since an empty
         # pool starves every unfilled lower quota — but quota-free instances
         # (all qmin = 0) still need the explicit check.
         out_of_people = ~jnp.any(alive, axis=1)
+        # per-chain, per-step noise from the chain's own key stream: chain
+        # identity (not batch position) determines the draw
+        noise = jax.vmap(
+            lambda ck: jax.random.gumbel(
+                jax.random.fold_in(ck, step), (n,), dtype=jnp.float32
+            )
+        )(chain_keys)
         new_state, person = _sample_step(
-            A_f32, A_T_f32, qmin, qmax, n, state, step_key, scores, households
+            A_f32, A_T_f32, qmin, qmax, n, state, noise, scores, households
         )
         alive2, selected2, failed2 = new_state
         return (alive2, selected2, failed2 | (failed | out_of_people)), person
 
     (alive, selected, failed), persons = jax.lax.scan(
-        body, (alive0, selected0, failed0), step_keys
+        body, (alive0, selected0, failed0), jnp.arange(k, dtype=jnp.uint32)
     )
     panels = persons.T  # [B, k]
 
@@ -155,7 +179,7 @@ def _sample_panels_kernel(dense: DenseInstance, key, B: int, scores=None, househ
 
 def sample_panels_batch(
     dense: DenseInstance, key, batch: int, scores=None, households=None,
-    sampler: str = "auto",
+    sampler: str = "auto", distribute: Optional[bool] = None,
 ):
     """Public batch draw; returns (panels[B,k], ok[B]) as device arrays.
 
@@ -164,7 +188,22 @@ def sample_panels_batch(
     (``kernels/sampler.py``); "auto" picks pallas on TPU, scan elsewhere.
     Both draw from the same greedy distribution (cross-checked statistically
     in ``tests/test_kernels.py``); per-seed streams differ.
+
+    ``distribute``: shard the chains across the device mesh (the production
+    multi-chip path for the reference's sequential 10k-draw estimator loop,
+    ``analysis.py:180-187``). ``None`` auto-enables it when more than one
+    device is visible; results are bit-identical to the single-device scan
+    kernel because chain randomness is keyed on global chain ids.
     """
+    if distribute is None:
+        distribute = len(jax.devices()) > 1 and batch >= len(jax.devices())
+    if distribute and sampler in ("auto", "scan"):
+        from citizensassemblies_tpu.parallel.mc import distributed_sample_panels
+        from citizensassemblies_tpu.parallel.mesh import default_mesh
+
+        return distributed_sample_panels(
+            dense, key, batch, default_mesh(), scores=scores, households=households
+        )
     if sampler == "auto":
         if jax.default_backend() == "tpu":
             from citizensassemblies_tpu.kernels.sampler import block_for_dense
@@ -188,6 +227,7 @@ def sample_feasible_panels(
     cfg: Optional[Config] = None,
     key=None,
     households: Optional[np.ndarray] = None,
+    distribute: Optional[bool] = None,
 ) -> Tuple[np.ndarray, int]:
     """Collect ``num`` accepted panels via batched rejection sampling.
 
@@ -208,7 +248,9 @@ def sample_feasible_panels(
     draws = 0
     while total < num:
         key, sub = jax.random.split(key)
-        panels, ok = sample_panels_batch(dense, sub, B, households=households)
+        panels, ok = sample_panels_batch(
+            dense, sub, B, households=households, distribute=distribute
+        )
         ok_np = np.asarray(ok)
         draws += B
         good = np.asarray(panels)[ok_np]
@@ -232,6 +274,7 @@ def legacy_probabilities(
     seed: int = 0,
     cfg: Optional[Config] = None,
     households: Optional[np.ndarray] = None,
+    distribute: Optional[bool] = None,
 ) -> LegacyResult:
     """Estimate the LEGACY probability allocation from ``iterations`` draws
     (the Monte-Carlo estimator of ``analysis.py:162-191``).
@@ -239,9 +282,16 @@ def legacy_probabilities(
     Returns per-agent selection frequencies, the set of unique panels observed,
     and the pair co-selection probability matrix (normalized by the draw count,
     ``analysis.py:86-88``).
+
+    ``distribute=None`` auto-shards the draws over every visible device
+    (bit-identical to the single-device path — chain randomness is keyed on
+    global chain ids); pass False/True to force either path.
     """
     cfg = cfg or default_config()
-    panels, draws = sample_feasible_panels(dense, iterations, seed=seed, cfg=cfg, households=households)
+    panels, draws = sample_feasible_panels(
+        dense, iterations, seed=seed, cfg=cfg, households=households,
+        distribute=distribute,
+    )
     n = dense.n
     denom = max(iterations, 1)
     counts = np.bincount(panels.ravel(), minlength=n)

@@ -59,9 +59,9 @@ def read_intersections(
             )
             pop.append(float(entry["population share"]))
 
-    A = np.asarray(dense.A)
-    qmin = np.asarray(dense.qmin)
-    qmax = np.asarray(dense.qmax)
+    A = dense.A_np
+    qmin = dense.qmin_np
+    qmax = dense.qmax_np
     masks = np.zeros((len(rows), A.shape[0]), dtype=bool)
     quota_share = np.zeros(len(rows))
     for r, (c1, f1, c2, f2) in enumerate(rows):

@@ -20,7 +20,61 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from citizensassemblies_tpu.core.instance import DenseInstance
-from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+from citizensassemblies_tpu.models.legacy import _sample_panels_kernel, chain_keys_for
+
+
+def distributed_sample_panels(
+    dense: DenseInstance,
+    key,
+    batch: int,
+    mesh: Mesh,
+    scores=None,
+    households=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Chain-parallel panel draw over the mesh, bit-identical to the
+    single-device kernel.
+
+    Every chain's randomness comes from its *global* chain id
+    (:func:`~citizensassemblies_tpu.models.legacy.chain_keys_for`), so device
+    d simply evaluates chains ``[d·B_local, (d+1)·B_local)`` of the same
+    stream the single-device kernel would produce — the production routing
+    for the reference's 10k-draw estimator loop (``analysis.py:180-187``).
+    Returns ``(panels int32[batch, k], ok bool[batch])``.
+    """
+    ndev = mesh.devices.size
+    B_local = -(-batch // ndev)  # ceil
+    total = B_local * ndev
+    keys = chain_keys_for(key, 0, total)
+    if scores is not None and getattr(scores, "ndim", 1) == 2 and scores.shape[0] > 1:
+        if scores.shape[0] < total:
+            scores = jnp.concatenate(
+                [jnp.asarray(scores, jnp.float32)]
+                + [jnp.zeros((total - scores.shape[0], dense.n), jnp.float32)],
+                axis=0,
+            )
+        score_spec = P(("chains", "agents"))
+    else:
+        score_spec = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(("chains", "agents")), score_spec),
+        out_specs=(P(("chains", "agents")), P(("chains", "agents"))),
+        check_vma=False,
+    )
+    def draw(local_keys, local_scores):
+        return _sample_panels_kernel(
+            dense,
+            local_keys[0],
+            B_local,
+            local_scores,
+            households,
+            chain_keys=local_keys,
+        )
+
+    panels, ok = draw(keys, scores if scores is not None else jnp.zeros((1, dense.n), jnp.float32))
+    return panels[:batch], ok[:batch]
 
 
 def distributed_mc_round(

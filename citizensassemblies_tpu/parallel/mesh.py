@@ -24,6 +24,22 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+_DEFAULT_MESH: Optional[Mesh] = None
+
+
+def default_mesh() -> Mesh:
+    """Process-wide chains×agents mesh over every visible device (cached).
+
+    The auto-distribution hook of ``sample_panels_batch`` uses this so the
+    production estimator shards without the caller managing a mesh; tests and
+    the driver's ``dryrun_multichip`` build explicit meshes instead.
+    """
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None or _DEFAULT_MESH.devices.size != len(jax.devices()):
+        _DEFAULT_MESH = make_mesh()
+    return _DEFAULT_MESH
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     axis_names: Tuple[str, str] = ("chains", "agents"),

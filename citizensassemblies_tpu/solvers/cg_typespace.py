@@ -33,6 +33,12 @@ from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.logging import RunLog
 
 _SLACK = 1e-9
+#: deduction applied to every fixed leximin value: the solver-reported stage
+#: optimum can overstate the true optimum by its own tolerance (~1e-8), and
+#: floors encoding overstated values leave later stages genuinely infeasible
+#: — a ratchet that compounds across stages. Fixing at z − margin keeps every
+#: floor strictly achievable; the understatement is far below the 1e-3 bar.
+_FIX_MARGIN = 1e-7
 
 
 class CompositionOracle:
@@ -191,6 +197,7 @@ def _marginal_probe_confirm(
     z: float,
     cand: np.ndarray,
     probe_tol: float = 1e-7,
+    floor_slack: float = _SLACK,
 ) -> np.ndarray:
     """Certify which candidate types are capped at ``z`` on the *marginal*
     optimal face ``{x ∈ X : x_u ≥ z·m_u ∀ unfixed u, x_f ≥ f·m_f}``.
@@ -209,7 +216,9 @@ def _marginal_probe_confirm(
     quota_A, quota_b = _quota_system(reduction)
     unfixed = fixed < 0
     lo = np.where(
-        unfixed, max(z - _SLACK, 0.0) * m, np.maximum(fixed, 0.0) * m - _SLACK
+        unfixed,
+        np.maximum(z - _FIX_MARGIN - floor_slack, 0.0) * m,
+        (np.maximum(fixed, 0.0) - floor_slack) * m,
     )
     lo = np.clip(lo, 0.0, m)
     bounds = [(lo[t], m[t]) for t in range(T)]
@@ -219,15 +228,22 @@ def _marginal_probe_confirm(
         r = robust_linprog(
             -w, A_ub=quota_A, b_ub=quota_b, A_eq=A_eq, b_eq=[k], bounds=bounds
         )
-        return None if r is None or r.status != 0 else float(-r.fun)
+        if r.status == 0:
+            return float(-r.fun)
+        return -np.inf if r.status == 2 else None  # infeasible vs failed
 
     cand = np.asarray(cand)
-    # the face floors are relaxed by _SLACK·m_u (unfixed) / _SLACK (fixed)
-    # raw units each; at most their sum can be re-routed into a candidate, so
-    # tightness must be judged up to that freed mass (normalized by m_t) or
-    # genuinely tight types probe "loose" on large pools, inflating later
-    # stage values by exactly the slack
-    slack_gain = _SLACK * (float(m.sum()) + T)
+    if z >= 1.0 - probe_tol:
+        # normalized type values cannot exceed 1 (x_t ≤ m_t), so every
+        # candidate is trivially capped at z — no LP needed, and the face at
+        # z ≈ 1 is often numerically empty anyway
+        return np.ones(len(cand), dtype=bool)
+    # the face floors are relaxed by (margin + slack)·m_t raw units each; at
+    # most their sum can be re-routed into a candidate, so tightness must be
+    # judged up to that freed mass (normalized by m_t) or genuinely tight
+    # types probe "loose" on large pools, inflating later stage values by
+    # exactly the slack
+    slack_gain = (_FIX_MARGIN + floor_slack) * float(m.sum())
     objectives = np.zeros((len(cand), T))
     objectives[np.arange(len(cand)), cand] = 1.0 / m[cand]
     return probe_confirm_tranche(
@@ -272,14 +288,19 @@ def _leximin_relaxation(
     quota_A, quota_b = _quota_system(reduction)
     stage = 0
     probes = 0
+    floor_slack = 0.0
     while (fixed < 0).any():
         stage += 1
         unfixed = fixed < 0
         uidx = np.nonzero(unfixed)[0]
         nu = len(uidx)
         # stage LP over [x, z]: max z s.t. x ∈ X, x_u ≥ z·m_u (unfixed),
-        # x_t ≥ f_t·m_t − slack via lower bounds (fixed)
-        lo_b = np.where(unfixed, 0.0, np.maximum(fixed, 0.0) * m - _SLACK)
+        # x_t ≥ (f_t − slack)·m_t via lower bounds (fixed). The slack ladder
+        # covers HiGHS's own primal feasibility tolerance: fixing at a
+        # solver-reported optimum can overstate the true optimum by ~1e-7,
+        # leaving later stages *genuinely* (numerically) infeasible at a
+        # 1e-9 slack; the probe allowances scale with the slack in use, so
+        # escalation costs tolerance budget only when actually needed.
         A_ub = np.zeros((2 * F + nu, T + 1))
         A_ub[: 2 * F, :T] = quota_A
         A_ub[2 * F + np.arange(nu), uidx] = -1.0
@@ -287,12 +308,26 @@ def _leximin_relaxation(
         b_ub = np.concatenate([quota_b, np.zeros(nu)])
         c = np.zeros(T + 1)
         c[T] = -1.0
-        res = robust_linprog(
-            c, A_ub=A_ub, b_ub=b_ub,
-            A_eq=np.concatenate([np.ones(T), [0.0]])[None, :], b_eq=[k],
-            bounds=[(lo_b[t], m[t]) for t in range(T)] + [(0, None)],
-        )
-        if res.status != 0:
+        res = None
+        for slack in sorted({floor_slack, 1e-8, 1e-7, 1e-6, 1e-5}):
+            if slack < floor_slack:
+                continue
+            lo_b = np.clip((np.where(unfixed, 0.0, np.maximum(fixed, 0.0)) - slack) * m, 0.0, m)
+            lo_b[unfixed] = 0.0
+            res = robust_linprog(
+                c, A_ub=A_ub, b_ub=b_ub,
+                A_eq=np.concatenate([np.ones(T), [0.0]])[None, :], b_eq=[k],
+                bounds=[(lo_b[t], m[t]) for t in range(T)] + [(0, None)],
+            )
+            if res.status == 0:
+                if slack > floor_slack:
+                    log.emit(
+                        f"Relaxation stage {stage}: floor slack escalated to "
+                        f"{slack:.0e} (solver-tolerance infeasibility)."
+                    )
+                floor_slack = slack
+                break
+        if res is None or res.status != 0:
             raise RuntimeError(f"relaxation stage LP failed: {res.message}")
         z = float(res.x[T])
         x_last = res.x[:T]
@@ -303,7 +338,9 @@ def _leximin_relaxation(
         if len(cand) == 0:
             cand = np.array([int(np.argmax(y * m[uidx]))])
 
-        conf = _marginal_probe_confirm(reduction, fixed, z, uidx[cand], probe_tol)
+        conf = _marginal_probe_confirm(
+            reduction, fixed, z, uidx[cand], probe_tol, floor_slack=floor_slack
+        )
         probes += 1 + (0 if conf.all() else len(cand))
         confirmed = np.zeros(T, dtype=bool)
         confirmed[uidx[cand[conf]]] = True
@@ -314,7 +351,10 @@ def _leximin_relaxation(
             rest = uidx[np.argsort(-(y * m[uidx]))]
             rest = np.array([t for t in rest if t not in set(uidx[cand])], dtype=int)
             for t in rest:
-                if _marginal_probe_confirm(reduction, fixed, z, np.array([t]), probe_tol)[0]:
+                if _marginal_probe_confirm(
+                    reduction, fixed, z, np.array([t]), probe_tol,
+                    floor_slack=floor_slack,
+                )[0]:
                     confirmed[t] = True
                     break
                 probes += 1
@@ -327,7 +367,7 @@ def _leximin_relaxation(
                     f"Relaxation stage {stage}: no probe-certified type at "
                     f"z={z:.6f}; falling back to the dual heuristic."
                 )
-        fixed = np.where(confirmed, max(0.0, z), fixed)
+        fixed = np.where(confirmed, max(0.0, z - _FIX_MARGIN), fixed)
     log.emit(f"Relaxation leximin: {stage} stages, ~{probes} probe LPs, values in "
              f"[{fixed.min():.6f}, {fixed.max():.6f}].")
     return fixed, x_last
@@ -447,79 +487,106 @@ def _slice_relaxation(
     # feeds back into `assigned` — so repair deviations self-correct in later
     # slices and the uniform mixture tracks x to ~1/R per type
     assigned = np.zeros(T, dtype=np.int64)
+    feat_of = np.asarray(reduction.type_feature)  # [T, ncat]
+    ncat = feat_of.shape[1]
+    tidx = np.arange(T)
+
+    def swap_repair(c: np.ndarray, counts: np.ndarray, j: int) -> bool:
+        """Greedy best-swap quota repair, vectorized per iteration.
+
+        Each pass scores every (donor, receiver) unit move by its exact
+        violation change — per-type removal/addition effects from the
+        feature-count deltas, with a correction for categories where donor
+        and receiver share a feature (their effects cancel there) — and
+        applies a best strictly-improving swap, breaking the (ubiquitous)
+        integer ties *randomly per slice*: a deterministic best-swap makes
+        every repaired slice collapse onto the same few patterns, and the
+        hull diversity the decomposition master depends on disappears
+        (measured: support 87 vs 180 columns, ε 3.8e-2 vs 2.0e-2).
+        Replaces a python double loop that dominated the slicer's runtime
+        at T ≈ 800.
+        """
+        tie = np.random.default_rng(j)
+        for _ in range(3 * reduction.F):
+            viol = np.maximum(counts - hi, 0) + np.maximum(lo - counts, 0)
+            total = int(viol.sum())
+            if total == 0:
+                return True
+            # per-feature violation deltas for one removal / one addition
+            dv_sub_f = (
+                np.maximum(counts - 1 - hi, 0) + np.maximum(lo - counts + 1, 0) - viol
+            )
+            dv_add_f = (
+                np.maximum(counts + 1 - hi, 0) + np.maximum(lo - counts - 1, 0) - viol
+            )
+            dv_sub = dv_sub_f[feat_of].sum(axis=1)  # [T] effect of c_t -= 1
+            dv_add = dv_add_f[feat_of].sum(axis=1)  # [T] effect of c_t += 1
+            # restrict to the worst violated features' member types — the
+            # all-pairs matrix at T ≈ 800 is what made repair slow
+            over = np.nonzero(counts > hi)[0]
+            under = np.nonzero(counts < lo)[0]
+            if len(over):
+                worst = over[np.argmax(viol[over])]
+                donors = np.nonzero((tf[:, worst] > 0) & (c > 0))[0]
+            else:
+                donors = np.nonzero(c > 0)[0]
+            if len(under):
+                worst = under[np.argmax(viol[under])]
+                receivers = np.nonzero((tf[:, worst] > 0) & (c < msize))[0]
+            else:
+                receivers = np.nonzero(c < msize)[0]
+            if len(donors) == 0 or len(receivers) == 0:
+                return False
+            delta = dv_sub[donors][:, None] + dv_add[receivers][None, :]
+            # shared-feature correction: in a category where donor and
+            # receiver have the same feature the move is a no-op there
+            for ci in range(ncat):
+                same = feat_of[donors, ci][:, None] == feat_of[receivers, ci][None, :]
+                corr = (
+                    dv_sub_f[feat_of[donors, ci]][:, None]
+                    + dv_add_f[feat_of[receivers, ci]][None, :]
+                )
+                delta = delta - np.where(same, corr, 0)
+            noisy = delta + tie.random(delta.shape) * 0.9
+            di, ri = np.unravel_index(np.argmin(noisy), delta.shape)
+            if delta[di, ri] >= 0:
+                return False
+            td, tr = donors[di], receivers[ri]
+            c[td] -= 1
+            c[tr] += 1
+            counts += tf[tr] - tf[td]
+        return bool(np.all(counts >= lo) and np.all(counts <= hi))
+
     out: List[np.ndarray] = []
     for j in range(1, R + 1):
         need = j * x - assigned
         c = np.maximum(np.floor(need + 1e-12), 0.0).astype(np.int64)
         c = np.minimum(c, msize)
         gap = k - int(c.sum())
-        counts = c @ tf
         if gap != 0:
-            # top up (or trim) the types with the largest (smallest)
-            # residual fraction, quota-aware; a per-slice golden-ratio
-            # jitter rotates exact ties
+            # top up (or trim) by residual fraction; a per-slice golden-ratio
+            # jitter rotates exact ties. Quota overshoot is left to the swap
+            # repair below.
             frac = need - np.floor(need + 1e-12)
-            jitter = ((np.arange(T) * 0.6180339887 + j * 0.7548776662) % 1.0) * 1e-6
+            jitter = ((tidx * 0.6180339887 + j * 0.7548776662) % 1.0) * 1e-6
             frac = frac + jitter
-            order = np.argsort(-frac) if gap > 0 else np.argsort(frac)
-            for t in order:
-                if gap == 0:
-                    break
-                row = tf[t]
-                if gap > 0 and c[t] < msize[t] and np.all(counts[row > 0] < hi[row > 0]):
-                    c[t] += 1
-                    counts += row
-                    gap -= 1
-                elif gap < 0 and c[t] > 0 and np.all(counts[row > 0] > lo[row > 0]):
-                    c[t] -= 1
-                    counts -= row
-                    gap += 1
+            if gap > 0:
+                order = np.argsort(-frac)
+                elig = order[c[order] < msize[order]][:gap]
+                c[elig] += 1
+                gap -= len(elig)
+            else:
+                order = np.argsort(frac)
+                elig = order[c[order] > 0][:-gap]
+                c[elig] -= 1
+                gap += len(elig)
         if gap != 0:
             assigned += c  # feed back even on drop, keeping the stream honest
             continue
-        # quota repair: unit swaps from a type in an over-full feature to a
-        # type in an under-full one (bounded effort; drop the slice if stuck)
-        for _ in range(3 * reduction.F):
-            over = np.nonzero(counts > hi)[0]
-            under = np.nonzero(counts < lo)[0]
-            if len(over) == 0 and len(under) == 0:
-                break
-            moved = False
-            donors = (
-                np.nonzero((tf[:, over[0]] > 0) & (c > 0))[0]
-                if len(over)
-                else np.nonzero(c > 0)[0]
-            )
-            receivers = (
-                np.nonzero((tf[:, under[0]] > 0) & (c < msize))[0]
-                if len(under)
-                else np.nonzero(c < msize)[0]
-            )
-            # rotate the starting point per slice for the same reason
-            if len(donors):
-                donors = np.roll(donors, -(j % len(donors)))
-            if len(receivers):
-                receivers = np.roll(receivers, -(j % len(receivers)))
-            for td in donors:
-                if moved:
-                    break
-                for tr in receivers:
-                    if td == tr:
-                        continue
-                    nc = counts - tf[td] + tf[tr]
-                    # the swap must strictly shrink the violation
-                    if np.sum(np.maximum(nc - hi, 0) + np.maximum(lo - nc, 0)) < np.sum(
-                        np.maximum(counts - hi, 0) + np.maximum(lo - counts, 0)
-                    ):
-                        c[td] -= 1
-                        c[tr] += 1
-                        counts = nc
-                        moved = True
-                        break
-            if not moved:
-                break
+        counts = c @ tf
+        ok = swap_repair(c, counts, j)
         assigned += c
-        if np.all(counts >= lo) and np.all(counts <= hi):
+        if ok:
             out.append(c.astype(np.int32))
     return out
 
@@ -629,9 +696,10 @@ def leximin_cg_typespace(
         np.add.at(out, (rows, tids.ravel()), 1)
         return out
 
-    # checkpoint resume: restore the generated portfolio + targets so a
-    # preempted long decomposition continues from its last round (SURVEY §5 —
-    # the reference restarts 4,000 s runs from zero)
+    # checkpoint resume: restore the generated portfolio + certified targets
+    # so a preempted decomposition restarts from its seed columns and skips
+    # the relaxation/coverage phases (coarse-grained — SURVEY §5; the
+    # reference restarts 4,000 s runs from zero)
     ckpt_fp = ""
     resumed = None
     if checkpoint_path is not None:
@@ -643,31 +711,28 @@ def leximin_cg_typespace(
         ckpt_fp = problem_fingerprint(dense, cfg, households)
         resumed = load_ts_state(checkpoint_path, T, ckpt_fp)
 
-    # ---- seeding: one batched device draw + per-uncovered-type coverage ----
+    # ---- seeding: relaxation-derived coverage (no device sampling) --------
+    # Phase 1's columns come from the aimed slicer below, which outperforms
+    # sampled panels; coverability comes from the relaxation leximin itself
+    # (v_t > 0 ⟹ some marginal point includes type t), with one exact
+    # forced-inclusion MILP per remaining suspect — so the expensive batched
+    # panel kernel never compiles on this path (the reference's coverage
+    # phase is per-uncovered-agent ILPs, leximin.py:279-289).
     if resumed is None:
+        with log.timer("relax_leximin"):
+            v_relax, x_star = _leximin_relaxation(reduction, log, probe_tol=cfg.probe_tol)
         with log.timer("seed"):
-            key, sub = jax.random.split(key)
-            budget = max(256, min(cfg.mw_rounds_factor * T, cfg.seed_batch))
-            panels, ok = sample_panels_batch(dense, sub, budget)
-            panels = np.asarray(panels)
-            ok = np.asarray(ok)
-            for c in panels_to_comps(panels[ok]):
-                add_comp(c)
-            coverable = np.zeros(T, dtype=bool)
-            for c in comps:
-                coverable |= c > 0
-            log.emit(
-                f"Seeding: {len(comps)} distinct compositions from {int(ok.sum())} "
-                f"sampled panels, covering {int(coverable.sum())}/{T} types."
-            )
-            for t in range(T):
-                if coverable[t]:
-                    continue
-                got = oracle.maximize((~coverable).astype(np.float64), forced_type=t)
+            coverable = v_relax > 1e-9
+            for t in np.nonzero(~coverable)[0]:
+                got = oracle.maximize(np.zeros(T), forced_type=int(t))
                 if got is None:
                     continue
                 add_comp(got[0])
-                coverable |= got[0] > 0
+                coverable[t] = True
+            log.emit(
+                f"Coverage: {int(coverable.sum())}/{T} types coverable "
+                f"(relaxation profile + {int((v_relax <= 1e-9).sum())} probe solves)."
+            )
     else:
         for c in resumed.compositions:
             add_comp(c)
@@ -701,58 +766,29 @@ def leximin_cg_typespace(
     # integer compositions. Success (ε ≈ 0) certifies the true leximin without
     # any stage-wise column generation; an integrality residual falls back to
     # the certified stage loop below.
-    start_round = 0
     if resumed is None:
-        with log.timer("relax_leximin"):
-            v_relax, x_star = _leximin_relaxation(reduction, log, probe_tol=cfg.probe_tol)
+        with log.timer("inject"):
             v_relax = np.where(coverable, v_relax, 0.0)
+            # aim the column hull at the *target* marginal v·m — the mixture
+            # the master must realize (M p = v ⇔ Σ p_c c = v·m). The last
+            # stage's vertex optimum x_star is a poor proxy: its early-fixed
+            # types sit above their floors, so slicing it leaves the master
+            # dozens of correction rounds short of the actual target.
+            x_target = v_relax * reduction.msize.astype(np.float64)
             injected = 0
-            for c in _slice_relaxation(x_star, reduction, R=1024):
+            for c in _slice_relaxation(x_target, reduction, R=1024):
                 injected += add_comp(c)
-            for c in _round_relaxation(x_star, reduction, rng, count=256):
-                injected += add_comp(c)
-            log.emit(f"Injected {injected} aimed columns around the relaxation optimum.")
+            if T <= 64:
+                # independent roundings only help at small type counts — at
+                # sf_e scale their quota-feasible yield is zero (measured)
+                for c in _round_relaxation(x_target, reduction, rng, count=256):
+                    injected += add_comp(c)
+            log.emit(f"Injected {injected} aimed columns around the relaxation target.")
     else:
         v_relax = resumed.v_relax
-        start_round = resumed.round
-    def prune_columns(p_now: np.ndarray, keep_last: int = 4000) -> bool:
-        """Column management: keep the LP support plus the freshest columns.
-        Only as a memory backstop — every observed prune visibly slowed the
-        ε decay (discarded columns carry hull information), so the threshold
-        sits well above the portfolio a normal decomposition reaches. Returns
-        True when columns were actually dropped (the caller must then discard
-        any PDHG warm start: its primal vector is ordered for the pre-prune
-        column set and a misaligned warm start silently degrades convergence).
-        """
-        if len(comps) <= 12000:
-            return False
-        keep = set(np.nonzero(p_now > 1e-12)[0].tolist())
-        keep.update(range(max(0, len(comps) - keep_last), len(comps)))
-        kept = [comps[i] for i in sorted(keep)]
-        comps.clear()
-        seen.clear()
-        for c in kept:
-            add_comp(c)
-        return True
-
     decomposed = False
-    import time as _time
-
-    eps_history: List[float] = []
-    for it in range(start_round, cfg.decomp_max_rounds):
-        t_round = _time.time()
-        if len(eps_history) >= 8 and eps_history[-1] > 10 * cfg.decomp_accept:
-            decay = eps_history[-1] / eps_history[-8]
-            if decay > 0.6:
-                # ≲6 %/round — the target sits on too many active floors for
-                # one-shot spanning; the stage loop (with its own per-stage
-                # aimed columns and bound certificates) closes faster
-                log.emit(
-                    f"Decomposition decaying slowly (ε={eps_history[-1]:.2e}, "
-                    f"×{decay:.2f}/8 rounds); switching to stage CG."
-                )
-                break
-        if checkpoint_path is not None and it > start_round:
+    with log.timer("decomp"):
+        if checkpoint_path is not None:
             from citizensassemblies_tpu.utils.checkpoint import TypeCGState, save_ts_state
 
             save_ts_state(
@@ -762,94 +798,37 @@ def leximin_cg_typespace(
                     v_relax=v_relax,
                     coverable=coverable,
                     key=np.asarray(key),
-                    round=it,
+                    round=0,
                     fingerprint=ckpt_fp,
                 ),
             )
-        M = np.stack(comps, axis=0).astype(np.float64) / msize[None, :]
-        MT = np.ascontiguousarray(M.T)
-        with log.timer("decomp_lp"):
-            # fast approximate rounds on device (warm-started PDHG at a loose
-            # tolerance is plenty for pricing guidance); an authoritative host
-            # IPM solve only when the estimate nears acceptance
-            authoritative = not use_pdhg
-            if use_pdhg:
-                eps_dev, w_dual, mu, probs, ok, pdhg_warm = solve_decomp_lp_pdhg(
-                    MT, v_relax, cfg=cfg, warm=pdhg_warm, tol=2e-5
-                )
-                if not ok or eps_dev <= 2.0 * cfg.decomp_accept:
-                    authoritative = True
-            if authoritative:
-                eps_dev, w_dual, mu, probs = _decomp_lp(MT, v_relax)
-        lp_solves += 1
-        eps_history.append(eps_dev)
-        if authoritative and eps_dev <= cfg.decomp_accept:
-            decomposed = True
-            log.emit(
-                f"Decomposition: profile realized after {it + 1} round(s), "
-                f"ε = {eps_dev:.2e} (two-sided), portfolio {len(comps)}."
-            )
-            break
-        if prune_columns(probs):
-            pdhg_warm = None
-        # price toward the targets: stochastic draw + exact MILP + roundings
-        w_type = w_dual / msize
-        key, sub = jax.random.split(key)
-        with log.timer("stochastic_pricing"):
-            from citizensassemblies_tpu.solvers.pricing import _pricing_scores
+        from citizensassemblies_tpu.solvers.face_decompose import realize_profile
 
-            scores = _pricing_scores(
-                np.asarray(w_type[type_id], dtype=np.float64), cfg.pricing_batch
-            )
-            panels, ok_mask = sample_panels_batch(
-                dense, sub, cfg.pricing_batch, scores=scores
-            )
-            cand = panels_to_comps(np.asarray(panels)[np.asarray(ok_mask)])
-        values = cand.astype(np.float64) @ w_type
-        added = 0
-        for i in np.argsort(-values):
-            if values[i] <= -mu + 1e-9:
-                break
-            if add_comp(cand[i]):
-                added += 1
-                if added >= cfg.cg_columns_typespace:
-                    break
-        with log.timer("exact_oracle"):
-            got = oracle.maximize(w_type)
-            exact_prices += 1
-            if got is not None and got[1] > -mu + 1e-9 and add_comp(got[0]):
-                added += 1
-            # multi-cut: extreme compositions at perturbed duals enlarge the
-            # master's hull much faster than interior samples (weights are
-            # mixed-sign in the two-sided master — keep the signs)
-            scale = float(np.mean(np.abs(w_type))) + 1e-12
-            for _ in range(cfg.decomp_multicut):
-                w_pert = w_type + rng.normal(0.0, 0.5 * scale, T)
-                got_p = oracle.maximize(w_pert)
-                exact_prices += 1
-                if got_p is not None and add_comp(got_p[0]):
-                    added += 1
-        log.emit(
-            f"  decomp round {it + 1}: ε={eps_dev:.2e} added {added} "
-            f"(portfolio {len(comps)}, {_time.time() - t_round:.1f}s)."
+        C_sup, probs, eps_dev, solves = realize_profile(
+            reduction,
+            v_relax,
+            list(comps),
+            oracle,
+            cfg.decomp_accept,
+            log=log,
+            max_rounds=cfg.decomp_max_rounds,
         )
-        if added == 0:
-            log.emit(
-                f"Decomposition stalled at ε = {eps_dev:.2e} "
-                f"(integrality residual); falling back to stage CG."
-            )
-            break
-    if not decomposed and probs is not None:
-        # authoritative final check before falling back to stage CG
-        M = np.stack(comps, axis=0).astype(np.float64) / msize[None, :]
-        eps_dev, _, _, probs = _decomp_lp(np.ascontiguousarray(M.T), v_relax)
-        lp_solves += 1
-        if eps_dev <= cfg.decomp_accept:
-            decomposed = True
-            log.emit(
-                f"Decomposition accepted at ε = {eps_dev:.2e} "
-                f"(≤ decomp_accept {cfg.decomp_accept:.0e})."
-            )
+        lp_solves += solves
+    if eps_dev <= cfg.decomp_accept:
+        decomposed = True
+        comps = [c.astype(np.int32) for c in C_sup]
+        log.emit(
+            f"Decomposition: profile realized, ε = {eps_dev:.2e} (two-sided), "
+            f"portfolio {len(comps)}."
+        )
+    else:
+        log.emit(
+            f"Face decomposition stalled at ε = {eps_dev:.2e} "
+            f"(integrality residual); falling back to stage CG."
+        )
+        # carry the certified support into the stage-CG portfolio
+        for c in C_sup:
+            add_comp(c)
     if decomposed:
         fixed = v_relax
         C = np.stack(comps, axis=0)
@@ -865,6 +844,26 @@ def leximin_cg_typespace(
         )
 
     # ---- phase 2 (fallback): certified stage-wise column generation --------
+    def prune_columns(p_now: np.ndarray, keep_last: int = 4000) -> bool:
+        """Column management: keep the LP support plus the freshest columns.
+        Only as a memory backstop — observed prunes visibly slowed the ε
+        decay (discarded columns carry hull information), so the threshold
+        sits well above the portfolio a normal stage loop reaches. Returns
+        True when columns were actually dropped (the caller must then discard
+        any PDHG warm start: its primal vector is ordered for the pre-prune
+        column set and a misaligned warm start silently degrades convergence).
+        """
+        if len(comps) <= 12000:
+            return False
+        keep = set(np.nonzero(p_now > 1e-12)[0].tolist())
+        keep.update(range(max(0, len(comps) - keep_last), len(comps)))
+        kept = [comps[i] for i in sorted(keep)]
+        comps.clear()
+        seen.clear()
+        for c in kept:
+            add_comp(c)
+        return True
+
     pdhg_warm = None
     while (fixed < 0).any():
         stages += 1
@@ -899,7 +898,7 @@ def leximin_cg_typespace(
                 # nothing marginal-certifiable (the hull face can be strictly
                 # inside the marginal face): reference dual heuristic
                 newly[unfixed_idx[np.argmax(y[unfixed_idx])]] = True
-            fixed = np.where(newly, max(0.0, z), fixed)
+            fixed = np.where(newly, max(0.0, z - _FIX_MARGIN), fixed)
             return int(newly.sum())
 
         while True:

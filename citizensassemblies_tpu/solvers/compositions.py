@@ -212,7 +212,9 @@ def leximin_over_compositions(
             nonlocal lp_solves
             r = _linprog(-obj_rows, A_p, b_p, A_eq_p, [1.0], bounds_p)
             lp_solves += 1
-            return None if r is None or r.status != 0 else float(-r.fun)
+            if r.status == 0:
+                return float(-r.fun)
+            return -np.inf if r.status == 2 else None  # infeasible vs failed
 
         # tranche candidates from the duals, probe-certified via the shared
         # group-then-individual scheme (lp_util.probe_confirm_tranche). The
@@ -236,8 +238,9 @@ def leximin_over_compositions(
         vals = MT[unfixed] @ np.maximum(res.x[:C], 0.0)
         for j in np.nonzero((y <= 1e-9) & (vals <= z + probe_tol))[0]:
             got = face_max(MT[unfixed[j]])
-            if got is None or got <= z + probe_tol + slack_gain / float(
-                msz[unfixed[j]]
+            if got == -np.inf or (
+                got is not None
+                and got <= z + probe_tol + slack_gain / float(msz[unfixed[j]])
             ):
                 tranche[j] = True
         if not tranche.any():

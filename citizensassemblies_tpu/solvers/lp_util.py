@@ -76,12 +76,22 @@ def probe_confirm_tranche(
     if n == 0:
         return confirmed
     allowances = np.asarray(allowances, dtype=np.float64)
+    # An *infeasible* face (face_max -inf) means no point attains
+    # min ≥ z − slack: the solver-reported stage optimum z slightly
+    # overstates the true optimum (its own feasibility tolerance), so
+    # nothing can exceed z materially — certify rather than stall into the
+    # dual heuristic. Any other solver failure (face_max None) certifies
+    # nothing: a numerical breakdown is not evidence of tightness.
     got = face_max(np.sum(objectives, axis=0))
-    if got is not None and got <= n * z + probe_tol + float(allowances.min()):
+    if got == -np.inf or (
+        got is not None and got <= n * z + probe_tol + float(allowances.min())
+    ):
         confirmed[:] = True
         return confirmed
     for i in range(n):
         got = face_max(objectives[i])
-        if got is not None and got <= z + probe_tol + float(allowances[i]):
+        if got == -np.inf or (
+            got is not None and got <= z + probe_tol + float(allowances[i])
+        ):
             confirmed[i] = True
     return confirmed

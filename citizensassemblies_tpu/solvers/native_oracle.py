@@ -81,11 +81,11 @@ class TypeReduction:
     the weights change per call."""
 
     def __init__(self, dense: DenseInstance):
-        A = np.asarray(dense.A, dtype=np.int8)
+        A = dense.A_np.astype(np.int8)
         self.n, self.F = A.shape
         self.k = int(dense.k)
-        self.qmin = np.asarray(dense.qmin, dtype=np.int32)
-        self.qmax = np.asarray(dense.qmax, dtype=np.int32)
+        self.qmin = dense.qmin_np.astype(np.int32)
+        self.qmax = dense.qmax_np.astype(np.int32)
         # category structure: columns of A are grouped by category via the
         # one-hot property (each agent has exactly one feature per category);
         # recover per-agent feature index per category from the dense rows

@@ -44,9 +44,9 @@ def problem_fingerprint(dense, cfg, households=None) -> str:
     import hashlib
 
     h = hashlib.sha256()
-    h.update(np.asarray(dense.A).astype(np.uint8).tobytes())
-    h.update(np.asarray(dense.qmin).tobytes())
-    h.update(np.asarray(dense.qmax).tobytes())
+    h.update(dense.A_np.astype(np.uint8).tobytes())
+    h.update(dense.qmin_np.tobytes())
+    h.update(dense.qmax_np.tobytes())
     h.update(str(dense.k).encode())
     h.update(repr(cfg).encode())
     if households is not None:

@@ -89,7 +89,7 @@ class Config:
     #: profile when the residual is below this (well under the 1e-3 L∞
     #: acceptance bar vs the reference's Gurobi allocations); only a larger
     #: residual — a genuine integrality gap — falls back to stage CG.
-    decomp_accept: float = 1e-4
+    decomp_accept: float = 5e-4
     #: pricing rounds attempted for the decomposition before falling back to
     #: stage-wise column generation.
     decomp_max_rounds: int = 60

@@ -61,6 +61,28 @@ def test_distributed_allocation_matches_dense(dense):
     np.testing.assert_allclose(alloc, P.T @ probs, atol=1e-5)
 
 
+def test_sample_panels_device_count_invariant(dense):
+    """The production draw is bit-identical sharded vs single-device: chain
+    randomness is keyed on global chain ids (VERDICT r1 #3)."""
+    key = jax.random.PRNGKey(11)
+    p1, ok1 = sample_panels_batch(dense, key, 200, distribute=False, sampler="scan")
+    p8, ok8 = sample_panels_batch(dense, key, 200, distribute=True)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p8))
+    np.testing.assert_array_equal(np.asarray(ok1), np.asarray(ok8))
+
+
+def test_legacy_probabilities_device_count_invariant(dense):
+    """The full Monte-Carlo estimator produces identical statistics whether
+    the 10k-draw loop runs on one device or sharded over the 8-device mesh."""
+    from citizensassemblies_tpu.models.legacy import legacy_probabilities
+
+    single = legacy_probabilities(dense, iterations=400, seed=3, distribute=False)
+    multi = legacy_probabilities(dense, iterations=400, seed=3, distribute=True)
+    np.testing.assert_array_equal(single.allocation, multi.allocation)
+    np.testing.assert_allclose(single.pair_matrix, multi.pair_matrix, atol=1e-6)
+    assert single.unique_panels == multi.unique_panels
+
+
 def test_graft_entry_single_chip():
     import __graft_entry__
 
