@@ -93,6 +93,12 @@ def build_parser(data_dir: Path) -> argparse.ArgumentParser:
                         help="override the 10,000 LEGACY Monte-Carlo draws")
     parser.add_argument("--generate", action="store_true",
                         help="generate the synthetic example datasets and exit")
+    parser.add_argument("--address-columns", nargs="+", default=None,
+                        metavar="COL",
+                        help="respondents.csv columns identifying a household; "
+                             "when given, every algorithm selects at most one "
+                             "member per household (the reference's "
+                             "check_same_address capability)")
     return parser
 
 
@@ -134,7 +140,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.mc_iterations is not None:
         cfg = cfg.replace(mc_iterations=args.mc_iterations)
 
-    instance = read_instance_dir(inst_dir, k=args.k)
+    households = None
+    if args.address_columns:
+        from citizensassemblies_tpu.core.instance import (
+            compute_households,
+            read_instance,
+        )
+
+        instance = read_instance(
+            inst_dir / "categories.csv",
+            inst_dir / "respondents.csv",
+            k=args.k,
+            name=inst_dir.name,
+            extra_columns=args.address_columns,
+        )
+        households = compute_households(instance, args.address_columns)
+    else:
+        instance = read_instance_dir(inst_dir, k=args.k)
     intersections = inst_dir / "intersections.csv"
     analyze_instance(
         instance,
@@ -143,6 +165,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         intersections_path=intersections if intersections.exists() else None,
         skip_timing=args.skiptiming,
         cfg=cfg,
+        households=households,
     )
     return 0
 

@@ -76,8 +76,17 @@ def analyze_instance(
     skip_timing: bool = False,
     cfg: Optional[Config] = None,
     echo: bool = True,
+    households=None,
 ) -> AnalysisResult:
-    """Full analysis pass over one instance (``analysis.py:533-636``)."""
+    """Full analysis pass over one instance (``analysis.py:533-636``).
+
+    ``households`` (int32[n] group ids, from
+    :func:`~citizensassemblies_tpu.core.instance.compute_households`) enables
+    the reference's ``check_same_address`` capability end-to-end: at most one
+    member per household in every panel, in all four algorithm passes (the
+    reference carries the flag through its uniform signature,
+    ``leximin.py:338-341``, though its own analysis always passes False).
+    """
     cfg = cfg or default_config()
     dense, space = featurize(instance)
     validate_quotas(instance)  # quota sanity asserts (analysis.py:174-176)
@@ -95,13 +104,17 @@ def analyze_instance(
     with tee_file(stats_path, echo=echo) as log:
         # --- four cached algorithm passes (analysis.py:536-543) -------------
         legacy_first = run_legacy_or_retrieve(dense, name=base, k=k, resample=False,
-                                              cache_dir=cache_dir, cfg=cfg)
+                                              cache_dir=cache_dir, cfg=cfg,
+                                              households=households)
         legacy_second = run_legacy_or_retrieve(dense, name=base, k=k, resample=True,
-                                               cache_dir=cache_dir, cfg=cfg)
+                                               cache_dir=cache_dir, cfg=cfg,
+                                               households=households)
         leximin = run_leximin_or_retrieve(dense, space, name=base, k=k,
-                                          cache_dir=cache_dir, cfg=cfg)
+                                          cache_dir=cache_dir, cfg=cfg,
+                                          households=households)
         xmin = run_xmin_or_retrieve(dense, space, name=base, k=k,
-                                    cache_dir=cache_dir, cfg=cfg)
+                                    cache_dir=cache_dir, cfg=cfg,
+                                    households=households)
         # the reference plots the *second* (seed-1) LEGACY sample and reports
         # its unique-panel count (analysis.py:575-589,604-607), while stats,
         # share-below, ratio and intersections use the first (:548,600,612,615)
@@ -209,7 +222,8 @@ def analyze_instance(
             durations = []
             for _ in range(3):
                 t0 = time.perf_counter()
-                find_distribution_leximin(dense, space, cfg=cfg, log=RunLog(echo=False))
+                find_distribution_leximin(dense, space, cfg=cfg, log=RunLog(echo=False),
+                                          households=households)
                 durations.append(time.perf_counter() - t0)
             timing_median = pystats.median(durations)
             log.log(

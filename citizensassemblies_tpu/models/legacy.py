@@ -193,7 +193,11 @@ def sample_panels_batch(
     multi-chip path for the reference's sequential 10k-draw estimator loop,
     ``analysis.py:180-187``). ``None`` auto-enables it when more than one
     device is visible; results are bit-identical to the single-device scan
-    kernel because chain randomness is keyed on global chain ids.
+    kernel because chain randomness is keyed on global chain ids. The
+    distributed path always uses the scan kernel — device-count invariance
+    is part of its contract and the Pallas kernel draws a different stream
+    (measured throughput is within a few percent either way; pass
+    ``distribute=False, sampler="pallas"`` to force the fused kernel).
     """
     if distribute is None:
         distribute = len(jax.devices()) > 1 and batch >= len(jax.devices())

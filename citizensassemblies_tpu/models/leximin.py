@@ -362,6 +362,12 @@ def find_distribution_leximin(
             key, sub = jax.random.split(key)
             covered = _seed_portfolio(dense, oracle, portfolio, cfg, sub, log, households)
         fixed = np.full(n, -1.0)  # < 0 ⇒ not yet fixed
+        if not initial_panels:
+            # agents the exact coverage solves proved to be in no feasible
+            # committee get probability 0 up front, as the reference does by
+            # excluding them from the optimization (leximin.py:286-296,364)
+            # — otherwise the first stages grind through z = 0 re-deriving it
+            fixed[~covered] = 0.0
         reduction_counter = 0
         dual_solves = 0
         exact_prices = 0

@@ -57,14 +57,19 @@ def find_distribution_xmin(
     n = dense.n
 
     # 2) portfolio expansion: the reference draws up to 5n fresh LEGACY panels
-    #    one-by-one (xmin.py:511-522); we draw the same budget in batches
-    budget = cfg.xmin_iterations_factor * n
+    #    one-by-one (xmin.py:511-522); we draw the same budget in batches.
+    #    The reference budget counts *distinct additions* (each of its 5n
+    #    iterations appends one panel not yet in the portfolio, retrying up
+    #    to 3n samples for it, ``xmin.py:464-474``) — so collect until 5n
+    #    new panels or the matching total-draw effort bound is spent.
+    target_new = cfg.xmin_iterations_factor * n
+    max_draws = 3 * n * target_new  # the reference's 5n × 3n attempt bound
     seen = {tuple(np.nonzero(row)[0].tolist()) for row in leximin.committees}
     new_rows: List[np.ndarray] = []
     key = jax.random.PRNGKey(cfg.solver_seed + 1)
     drawn = 0
-    while drawn < budget:
-        B = min(cfg.pricing_batch, budget - drawn)
+    while len(new_rows) < target_new and drawn < max_draws:
+        B = min(cfg.pricing_batch, max_draws - drawn)
         key, sub = jax.random.split(key)
         panels, ok = sample_panels_batch(dense, sub, B, households=households)
         panels = np.sort(np.asarray(panels), axis=1)
@@ -77,6 +82,8 @@ def find_distribution_xmin(
                 row = np.zeros(n, dtype=bool)
                 row[list(tup)] = True
                 new_rows.append(row)
+                if len(new_rows) >= target_new:
+                    break
     if new_rows:
         P = np.concatenate([leximin.committees, np.stack(new_rows)], axis=0)
     else:

@@ -1,0 +1,158 @@
+"""Mesh-sharded PDHG for the dual leximin LP.
+
+At reference scale one chip holds the whole portfolio, but the framework's
+scaling axis is the portfolio/pool size (SURVEY §5 "long-context analog"):
+the dual LP's constraint matrix is the C×n committee matrix, and at large C
+its two GEMVs per PDHG iteration are the memory-bound hot loop. Here they
+run under ``shard_map`` with the portfolio rows laid out over the mesh
+(both mesh axes flattened into one row-parallel axis):
+
+* ``G x̄`` needs only local rows — no communication;
+* ``Gᵀ λ`` is a local [rows_local, n]ᵀ @ [rows_local] GEMV followed by one
+  ``psum`` over the mesh — the collective rides ICI.
+
+The primal iterate ``x`` and the equality dual ``μ`` stay replicated (they
+are n+1-sized — tiny); every device therefore computes identical updates
+from the psum-reduced gradient, so the sharded solve is deterministic and
+device-count-invariant. Scalings (Ruiz) and the step size are computed on
+host once per solve; convergence is checked between jitted blocks.
+
+Exactness contract: same as the single-device PDHG — callers treat a
+non-converged result as "fall back to host HiGHS".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from citizensassemblies_tpu.solvers.highs_backend import DualSolution
+from citizensassemblies_tpu.utils.config import Config, default_config
+
+
+def _ruiz_host(K: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host view of the shared Ruiz equilibration (``lp_pdhg._ruiz_equilibrate``)."""
+    from citizensassemblies_tpu.solvers.lp_pdhg import _ruiz_equilibrate
+
+    d_r, d_c = _ruiz_equilibrate(jnp.asarray(K, jnp.float32))
+    return np.asarray(d_r, np.float64), np.asarray(d_c, np.float64)
+
+
+def solve_dual_lp_pdhg_sharded(
+    P_mat: np.ndarray,
+    fixed: np.ndarray,
+    mesh: Mesh,
+    cfg: Optional[Config] = None,
+    tol: Optional[float] = None,
+    max_blocks: int = 60,
+    block_iters: int = 512,
+) -> DualSolution:
+    """Dual leximin LP (``leximin.py:300-328``) with mesh-sharded GEMVs.
+
+    Variables ``z = [y (n), ŷ]``; ``min ŷ − Σ fixedᵢ yᵢ`` s.t.
+    ``P y − ŷ·1 ≤ 0``, ``Σ_unfixed y = 1``, ``z ≥ 0``. Returns the standard
+    :class:`DualSolution` (``ok=False`` ⇒ use the host fallback).
+    """
+    cfg = cfg or default_config()
+    tol = float(cfg.pdhg_tol if tol is None else tol)
+    P_mat = np.asarray(P_mat, dtype=np.float64)
+    C, n = P_mat.shape
+    ndev = mesh.devices.size
+    fixed = np.asarray(fixed, dtype=np.float64)
+    unfixed = fixed < 0
+    fixed_vals = np.where(unfixed, 0.0, fixed)
+
+    # pad rows to a device multiple; a zero row adds ŷ ≥ 0 (already implied)
+    rows = -(-C // ndev) * ndev
+    G = np.zeros((rows, n + 1))
+    G[:C, :n] = P_mat
+    G[:, n] = -1.0
+    h = np.zeros(rows)
+    A = np.concatenate([unfixed.astype(np.float64), [0.0]])[None, :]
+    b = np.array([1.0])
+    c = np.concatenate([-fixed_vals, [1.0]])
+
+    K = np.concatenate([G, A], axis=0)
+    d_r, d_c = _ruiz_host(K)
+    Ks = K * d_r[:, None] * d_c[None, :]
+    cs = c * d_c
+    hs = h * d_r[:rows]
+    bs = b * d_r[rows:]
+    Gs = Ks[:rows]
+    As = Ks[rows:]
+    # ‖K‖₂ by host power iteration
+    x = np.random.default_rng(0).standard_normal(n + 1)
+    for _ in range(20):
+        x = Ks.T @ (Ks @ x)
+        x /= np.linalg.norm(x) + 1e-30
+    norm = float(np.linalg.norm(Ks @ x))
+    tau = sigma = 0.9 / max(norm, 1e-12)
+    scale = 1.0 + float(np.linalg.norm(cs) + np.linalg.norm(hs) + np.linalg.norm(bs))
+
+    axes = mesh.axis_names  # both flattened into one row-parallel axis
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axes), P(axes), P(), P()),
+        out_specs=(P(), P(axes), P()),
+        check_vma=False,
+    )
+    def block(G_l, lam_l, x, mu):
+        G_l = G_l.astype(jnp.float32)
+        h_l = jnp.zeros(G_l.shape[0], jnp.float32)  # hs is all zeros by construction
+
+        def one_iter(carry, _):
+            x, lam_l, mu = carry
+            gT = jax.lax.psum(G_l.T @ lam_l, axes)
+            grad = cs_d + gT + As_d[0] * mu[0]
+            x_new = jnp.maximum(x - tau * grad, 0.0)
+            xb = 2.0 * x_new - x
+            lam_l = jnp.maximum(lam_l + sigma * (G_l @ xb - h_l), 0.0)
+            mu = mu + sigma * (As_d @ xb - bs_d)
+            return (x_new, lam_l, mu), None
+
+        (x, lam_l, mu), _ = jax.lax.scan(
+            one_iter, (x, lam_l, mu), None, length=block_iters
+        )
+        return x, lam_l, mu
+
+    cs_d = jnp.asarray(cs, jnp.float32)
+    As_d = jnp.asarray(As, jnp.float32)
+    bs_d = jnp.asarray(bs, jnp.float32)
+    tau = jnp.float32(tau)
+    sigma = jnp.float32(sigma)
+
+    x = np.zeros(n + 1, dtype=np.float32)
+    lam = np.zeros(rows, dtype=np.float32)
+    mu = np.zeros(1, dtype=np.float32)
+    Gs_dev = jnp.asarray(Gs.astype(np.float32))  # upload the matrix once
+    res = np.inf
+    it = 0
+    for _ in range(max_blocks):
+        x, lam, mu = block(Gs_dev, jnp.asarray(lam), jnp.asarray(x), jnp.asarray(mu))
+        x, lam, mu = np.asarray(x), np.asarray(lam), np.asarray(mu)
+        it += block_iters
+        # host KKT residual (same combined form as the single-device core)
+        primal = max(
+            float(np.maximum(Gs @ x - hs, 0.0).max(initial=0.0)),
+            float(np.abs(As @ x - bs).max(initial=0.0)),
+        )
+        dual = float(np.maximum(-(cs + Gs.T @ lam + As.T @ mu), 0.0).max(initial=0.0))
+        gap = abs(float(cs @ x + hs @ lam + bs @ mu))
+        res = (primal + dual + gap / scale) / 1.0
+        if res <= tol * 4.0:
+            break
+
+    # unscale
+    z = x * d_c
+    y = z[:n].astype(np.float64)
+    yhat = float(z[n])
+    objective = float(c @ (x * d_c))
+    return DualSolution(ok=bool(res <= tol * 4.0), y=y, yhat=yhat, objective=objective)

@@ -413,51 +413,6 @@ def _decomp_lp(MT: np.ndarray, v: np.ndarray) -> Tuple[float, np.ndarray, float,
     return float(res.x[C]), w, mu, np.maximum(res.x[:C], 0.0)
 
 
-def solve_decomp_lp_pdhg(
-    MT: np.ndarray,
-    v: np.ndarray,
-    cfg: Optional[Config] = None,
-    warm=None,
-    tol: Optional[float] = None,
-):
-    """Device PDHG for the two-sided decomposition master (see
-    :func:`_decomp_lp`); loose-tolerance rounds guide pricing, the host IPM
-    stays authoritative near acceptance. Returns ``(ε, w, μ, p, ok, warm)``."""
-    from citizensassemblies_tpu.solvers.lp_pdhg import solve_lp
-
-    cfg = cfg or default_config()
-    T, C = MT.shape
-    v = np.asarray(v, dtype=np.float64)
-    bucket = 4096
-    Cp = ((C + bucket - 1) // bucket) * bucket
-    G = np.zeros((2 * T, Cp + 1))
-    G[:T, :C] = -MT
-    G[T:, :C] = MT
-    G[:, Cp] = -1.0
-    h = np.concatenate([-v, v])
-    A = np.zeros((1, Cp + 1))
-    A[0, :C] = 1.0
-    b = np.array([1.0])
-    c_obj = np.zeros(Cp + 1)
-    c_obj[Cp] = 1.0
-    if warm is not None and warm[0].shape[0] != Cp + 1:
-        x_w = np.zeros(Cp + 1)
-        m = min(C, warm[0].shape[0] - 1)
-        x_w[:m] = warm[0][:m]
-        x_w[Cp] = warm[0][-1]
-        warm = (x_w, warm[1], warm[2])
-    sol = solve_lp(c_obj, G, h, A, b, cfg=cfg, warm=warm, tol=tol)
-    w = sol.lam[:T] - sol.lam[T:]
-    return (
-        float(max(sol.x[Cp], 0.0)),
-        w,
-        float(sol.mu[0]),
-        sol.x[:C],
-        sol.ok,
-        (sol.x, sol.lam, sol.mu),
-    )
-
-
 def _slice_relaxation(
     x: np.ndarray,
     reduction: TypeReduction,
@@ -720,7 +675,7 @@ def leximin_cg_typespace(
     # phase is per-uncovered-agent ILPs, leximin.py:279-289).
     if resumed is None:
         with log.timer("relax_leximin"):
-            v_relax, x_star = _leximin_relaxation(reduction, log, probe_tol=cfg.probe_tol)
+            v_relax, _ = _leximin_relaxation(reduction, log, probe_tol=cfg.probe_tol)
         with log.timer("seed"):
             coverable = v_relax > 1e-9
             for t in np.nonzero(~coverable)[0]:
@@ -788,7 +743,7 @@ def leximin_cg_typespace(
         v_relax = resumed.v_relax
     decomposed = False
     with log.timer("decomp"):
-        if checkpoint_path is not None:
+        if checkpoint_path is not None and comps:
             from citizensassemblies_tpu.utils.checkpoint import TypeCGState, save_ts_state
 
             save_ts_state(

@@ -177,9 +177,15 @@ def realize_profile(
         cut = int(np.searchsorted(cum, frac * cum[-1])) + 1
         return order[: min(max(cut, 1), cap)]
 
+    if not cols:
+        # nothing to decompose from (pathological seeding) — report failure
+        # so the caller takes the stage-CG fallback
+        return np.zeros((0, T), np.int32), np.zeros(0), float("inf"), 0
+
     lp_solves = 0
     eps = np.inf
     p = np.zeros(0)
+    p_aligned = False  # p indexes the *current* cols list
     rng = np.random.default_rng(0)
     eps_hist: List[float] = []
     for rnd in range(max_rounds):
@@ -198,6 +204,7 @@ def realize_profile(
         MT = np.ascontiguousarray((C.astype(np.float64) / m[None, :]).T)
         eps, w, _mu, p = _decomp_lp(MT, v)
         lp_solves += 1
+        p_aligned = True
         eps_hist.append(eps)
         if eps <= accept:
             # return this certified master as-is: re-solving on a restricted
@@ -218,6 +225,7 @@ def realize_profile(
         seen.clear()
         for c in kept:
             add(c)
+        p_aligned = False
         base = len(cols)
         cand: List[np.ndarray] = []
         if kept:
@@ -254,7 +262,14 @@ def realize_profile(
         if added == 0:
             break
 
-    sup = top_mass(p, cap=4096) if len(p) == len(cols) else np.arange(len(cols))
+    if not p_aligned:
+        # the loop exited after a prune/extend: p ranks the OLD column order,
+        # so re-solve once on the current set before selecting the support
+        C = np.stack(cols, axis=0)
+        MT = np.ascontiguousarray((C.astype(np.float64) / m[None, :]).T)
+        eps, _w, _mu, p = _decomp_lp(MT, v)
+        lp_solves += 1
+    sup = top_mass(p, cap=4096)
     C_sup = np.stack([cols[i] for i in sup]).astype(np.int32)
     MT = np.ascontiguousarray((C_sup.astype(np.float64) / m[None, :]).T)
     eps, _w, _mu, p_sup = _decomp_lp(MT, v)

@@ -99,8 +99,12 @@ class Config:
     decomp_multicut: int = 32
 
     # --- XMIN -----------------------------------------------------------------
-    #: portfolio-expansion iterations as a multiple of n (reference ``xmin.py:511``).
-    xmin_iterations_factor: int = 5
+    #: portfolio-expansion budget as a multiple of n, counted in *distinct*
+    #: panels added. The reference iterates 5n one-panel expansions
+    #: (``xmin.py:511``) but its per-iteration CG re-solves add further
+    #: pricing columns, so its final support exceeds 5n + seed; 8n distinct
+    #: batched draws reaches the same support without the O(n) re-solves.
+    xmin_iterations_factor: int = 8
     #: attempts to sample a panel not already in the portfolio, as a multiple
     #: of n (reference ``xmin.py:466``).
     xmin_dedup_attempts_factor: int = 3

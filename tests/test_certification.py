@@ -1,0 +1,90 @@
+"""Independent at-scale exactness evidence (VERDICT r1 items #2 and #6).
+
+The production LEXIMIN path is the type-space solver (probe-certified
+relaxation + face decomposition). These tests cross-check it against the
+*agent-space* HiGHS-certified column-generation path — forced by passing
+singleton households, which disables the type collapse without changing the
+problem (≤1-per-household rows over singletons are vacuous) — the role
+Gurobi's dual-gap certificate plays for the reference
+(``/root/reference/leximin.py:429-431``).
+"""
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import random_instance, skewed_instance
+from citizensassemblies_tpu.core.instance import Instance, featurize
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.ops.stats import prob_allocation_stats
+
+
+def _mass24_shaped(seed: int = 3) -> Instance:
+    """A mass_24-shaped instance: n=70, k=24, 5 categories, with two
+    categories fully pinned (min = max on every cell) — the degenerate/tight
+    regime SURVEY §7 flags as a top risk (the real mass pool is withheld;
+    shape from ``reference_output/mass_24_statistics.txt:2-4``)."""
+    base = random_instance(
+        n=70, k=24, n_categories=5, features_per_category=[2, 3, 2, 3, 2],
+        seed=seed, name="mass24_shaped",
+    )
+    cats = {}
+    for ci, (cat, feats) in enumerate(base.categories.items()):
+        names = list(feats)
+        counts = np.array(
+            [sum(1 for a in base.agents if a[cat] == f) for f in names], float
+        )
+        if ci < 2:
+            # pin to the proportional integer composition: min = max
+            exact = np.floor(counts / 70.0 * 24.0).astype(int)
+            order = np.argsort(-(counts / 70.0 * 24.0 - exact))
+            for j in order[: 24 - exact.sum()]:
+                exact[j] += 1
+            cats[cat] = {f: (int(c), int(c)) for f, c in zip(names, exact)}
+        else:
+            cats[cat] = feats
+    import dataclasses
+
+    return dataclasses.replace(base, categories=cats)
+
+
+def test_mass24_shaped_tight_quotas_full_stack():
+    """min=max cells through the full type-space solver stack, cross-checked
+    against the agent-space HiGHS-certified CG."""
+    inst = _mass24_shaped()
+    dense, space = featurize(inst)
+    qmin = dense.qmin_np
+    qmax = dense.qmax_np
+    assert int((qmin == qmax).sum()) >= 5  # genuinely tight cells
+
+    ts = find_distribution_leximin(dense, space)
+    # every support panel satisfies every quota exactly
+    for row, p in zip(ts.committees, ts.probabilities):
+        if p <= 1e-11:
+            continue
+        counts = dense.A_np[row].sum(axis=0)
+        assert np.all(counts >= qmin) and np.all(counts <= qmax)
+    assert ts.allocation.sum() == pytest.approx(24.0, abs=1e-6)
+
+    ag = find_distribution_leximin(dense, space, households=np.arange(70))
+    # allocations agree as distributions (agents are type-interchangeable, so
+    # compare the sorted profiles)
+    np.testing.assert_allclose(
+        np.sort(ts.allocation), np.sort(ag.allocation), atol=1e-3
+    )
+    s_ts = prob_allocation_stats(ts.allocation, cap_for_geometric_mean=False)
+    s_ag = prob_allocation_stats(ag.allocation, cap_for_geometric_mean=False)
+    assert s_ts.min == pytest.approx(s_ag.min, abs=1e-3)
+    assert s_ts.gini == pytest.approx(s_ag.gini, abs=5e-3)
+
+
+def test_skewed_midsize_matches_agent_space_certified():
+    """Heterogeneous-regime cross-check at mid size: the type-space result
+    matches the agent-space HiGHS-certified CG within tolerance (VERDICT r1
+    #2a, extending the n=40 cross-check upward)."""
+    inst = skewed_instance(n=120, k=12, n_categories=3, seed=1)
+    dense, space = featurize(inst)
+    ts = find_distribution_leximin(dense, space)
+    ag = find_distribution_leximin(dense, space, households=np.arange(120))
+    np.testing.assert_allclose(
+        np.sort(ts.allocation), np.sort(ag.allocation), atol=1e-3
+    )

@@ -140,3 +140,23 @@ def test_instance_sweep_rejects_mixed_k():
     d2, _ = featurize(random_instance(n=30, k=6, n_categories=2, seed=0))
     with _pytest.raises(ValueError):
         pad_and_stack([d1, d2])
+
+
+def test_sharded_dual_lp_matches_highs(dense):
+    """Dual-LP PDHG with mesh-sharded GEMVs (rows over the mesh, psum'd
+    transposes) reproduces the exact host LP (VERDICT r1 item #4)."""
+    from citizensassemblies_tpu.models.legacy import sample_feasible_panels
+    from citizensassemblies_tpu.parallel.solver import solve_dual_lp_pdhg_sharded
+    from citizensassemblies_tpu.solvers.highs_backend import solve_dual_lp
+
+    panels, _ = sample_feasible_panels(dense, 600, seed=2)
+    P_mat = np.zeros((600, dense.n), dtype=bool)
+    for r, row in enumerate(panels):
+        P_mat[r, row] = True
+    fixed = np.full(dense.n, -1.0)
+    exact = solve_dual_lp(P_mat, fixed)
+    mesh = make_mesh(8, agents_axis=2)
+    got = solve_dual_lp_pdhg_sharded(P_mat, fixed, mesh)
+    assert got.ok
+    assert abs(got.objective - exact.objective) < 1e-4
+    assert abs(got.yhat - exact.yhat) < 1e-4
