@@ -126,29 +126,55 @@ def main() -> None:
         # an upper bound in leximin order computed independently of the
         # decomposition that produced the allocation, so realizing it within
         # ε certifies the allocation is the true leximin to that tolerance.
+        #
+        # The flagship number is a MEDIAN OF BENCH_REPS (default 3) runs,
+        # mirroring the reference's own timing harness (analysis.py:625-634),
+        # over two generator seeds — seed 1 (heavy skew, the tuned realistic
+        # regime) and seed 0 (mild skew). The 4011.6 s baseline was measured
+        # on the withheld real pool, not these synthetic stand-ins, so it is
+        # marked estimated.
         from citizensassemblies_tpu.core.generator import sf_e_skewed_instance
+        from citizensassemblies_tpu.utils.logging import RunLog
 
-        for name, builder in (
-            ("sf_e_skewed", sf_e_skewed_instance),
-            ("sf_e_like", sf_e_like_instance),
+        reps = int(os.environ.get("BENCH_REPS", "3"))
+        for name, builder, seeds in (
+            ("sf_e_skewed", sf_e_skewed_instance, (1, 0)),
+            ("sf_e_like", sf_e_like_instance, (0,)),
         ):
-            sfe_dense, sfe_space = featurize(builder())
-            t0 = time.time()
-            sfe = find_distribution_leximin(sfe_dense, sfe_space)
-            sfe_elapsed = time.time() - t0
-            dev = float(abs(sfe.allocation - sfe.fixed_probabilities).max())
-            sfe_stats = prob_allocation_stats(
-                sfe.allocation, cap_for_geometric_mean=False
-            )
-            base_key = f"{name}_110"
-            detail[name] = {
-                "seconds": round(sfe_elapsed, 1),
-                "baseline_s": BASELINES[base_key],
-                "speedup": round(BASELINES[base_key] / max(sfe_elapsed, 1e-9), 1),
-                "alloc_linf_dev": round(dev, 8),
-                "min_prob": round(float(sfe.allocation.min()), 6),
-                "gini": round(sfe_stats.gini, 4),
-            }
+            for seed in seeds:
+                sfe_dense, sfe_space = featurize(builder(seed=seed))
+                runs = []
+                for _ in range(reps):
+                    rlog = RunLog(echo=False)
+                    t0 = time.time()
+                    sfe = find_distribution_leximin(sfe_dense, sfe_space, log=rlog)
+                    runs.append((time.time() - t0, rlog.timers))
+                runs.sort(key=lambda r: r[0])
+                times = [r[0] for r in runs]
+                # phase split of the MEDIAN rep, so the breakdown matches the
+                # reported wall-clock (rep 1 may pay XLA compiles)
+                median_s, median_timers = runs[len(runs) // 2]
+                dev = float(abs(sfe.allocation - sfe.fixed_probabilities).max())
+                sfe_stats = prob_allocation_stats(
+                    sfe.allocation, cap_for_geometric_mean=False
+                )
+                base_key = f"{name}_110"
+                key = name if seed == seeds[0] else f"{name}_seed{seed}"
+                detail[key] = {
+                    "seconds": round(median_s, 1),
+                    "runs_s": [round(t, 1) for t in times],
+                    "baseline_s": BASELINES[base_key],
+                    "baseline_estimated": True,
+                    "speedup": round(BASELINES[base_key] / max(median_s, 1e-9), 1),
+                    "alloc_linf_dev": round(dev, 8),
+                    "min_prob": round(float(sfe.allocation.min()), 6),
+                    "gini": round(sfe_stats.gini, 4),
+                    "phase_times": {
+                        k: round(v, 1) for k, v in sorted(
+                            rlog.timers.items(), key=lambda kv: -kv[1]
+                        )
+                    },
+                }
 
     if os.environ.get("BENCH_SKIP_SAMPLER", "") != "1":
         # sampler throughput on the sf_e-shaped pool (the hot MC kernel)

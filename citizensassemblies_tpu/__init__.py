@@ -26,6 +26,28 @@ allocation is ``π = P.T @ p`` — all one-line jittable reductions.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# Persistent XLA compilation cache: the solver stack jits a handful of
+# bucket-padded PDHG/sampler shapes whose compiles cost seconds each; caching
+# them on disk makes every process after the first start warm (the reference
+# has no compilation step to amortize — this keeps cold-start parity).
+if not _os.environ.get("CITIZENS_TPU_NO_COMPILE_CACHE"):
+    try:
+        import jax as _jax
+
+        # respect a cache dir the host application (or env) already chose
+        if getattr(_jax.config, "jax_compilation_cache_dir", None) is None:
+            _jax.config.update(
+                "jax_compilation_cache_dir",
+                _os.path.join(
+                    _os.path.expanduser("~"), ".cache", "citizensassemblies_tpu_xla"
+                ),
+            )
+            _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - cache is a pure optimization
+        pass
+
 from citizensassemblies_tpu.core.instance import (  # noqa: F401
     DenseInstance,
     FeatureSpace,

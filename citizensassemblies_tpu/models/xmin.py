@@ -63,7 +63,11 @@ def find_distribution_xmin(
     #    to 3n samples for it, ``xmin.py:464-474``) — so collect until 5n
     #    new panels or the matching total-draw effort bound is spent.
     target_new = cfg.xmin_iterations_factor * n
-    max_draws = 3 * n * target_new  # the reference's 5n × 3n attempt bound
+    # total-draw effort bound: dedup_attempts_factor·n tries per distinct
+    # addition (the reference's 3n, ``xmin.py:466``) × target_new additions
+    # (cfg.xmin_iterations_factor·n distinct panels — see config.py for why
+    # that exceeds the reference's literal 5n iteration count)
+    max_draws = cfg.xmin_dedup_attempts_factor * n * target_new
     seen = {tuple(np.nonzero(row)[0].tolist()) for row in leximin.committees}
     new_rows: List[np.ndarray] = []
     key = jax.random.PRNGKey(cfg.solver_seed + 1)

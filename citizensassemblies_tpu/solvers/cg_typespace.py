@@ -198,6 +198,7 @@ def _marginal_probe_confirm(
     cand: np.ndarray,
     probe_tol: float = 1e-7,
     floor_slack: float = _SLACK,
+    log: Optional[RunLog] = None,
 ) -> np.ndarray:
     """Certify which candidate types are capped at ``z`` on the *marginal*
     optimal face ``{x ∈ X : x_u ≥ z·m_u ∀ unfixed u, x_f ≥ f·m_f}``.
@@ -242,12 +243,22 @@ def _marginal_probe_confirm(
     # most their sum can be re-routed into a candidate, so tightness must be
     # judged up to that freed mass (normalized by m_t) or genuinely tight
     # types probe "loose" on large pools, inflating later stage values by
-    # exactly the slack
+    # exactly the slack (the shared prober clamps the allowance so an
+    # escalated slack ladder can never certify at a tolerance material
+    # against the 1e-3 bar); each candidate's own value may also sit up to
+    # margin + slack below z on the face, which the prober charges against
+    # the group test's budget
     slack_gain = (_FIX_MARGIN + floor_slack) * float(m.sum())
     objectives = np.zeros((len(cand), T))
     objectives[np.arange(len(cand)), cand] = 1.0 / m[cand]
     return probe_confirm_tranche(
-        face_max, objectives, z, probe_tol, slack_gain / m[cand]
+        face_max,
+        objectives,
+        z,
+        probe_tol,
+        slack_gain / m[cand],
+        term_deficit=_FIX_MARGIN + floor_slack,
+        log=log.emit if log is not None else None,
     )
 
 
@@ -301,10 +312,13 @@ def _leximin_relaxation(
         # leaving later stages *genuinely* (numerically) infeasible at a
         # 1e-9 slack; the probe allowances scale with the slack in use, so
         # escalation costs tolerance budget only when actually needed.
-        A_ub = np.zeros((2 * F + nu, T + 1))
-        A_ub[: 2 * F, :T] = quota_A
-        A_ub[2 * F + np.arange(nu), uidx] = -1.0
-        A_ub[2 * F :, T] = m[uidx]
+        A_dense = np.zeros((2 * F + nu, T + 1))
+        A_dense[: 2 * F, :T] = quota_A
+        A_dense[2 * F + np.arange(nu), uidx] = -1.0
+        A_dense[2 * F :, T] = m[uidx]
+        # the floor block is −I plus one dense column: sparse storage roughly
+        # halves HiGHS's stage-LP time at T ≈ 1000
+        A_ub = scipy.sparse.csr_matrix(A_dense)
         b_ub = np.concatenate([quota_b, np.zeros(nu)])
         c = np.zeros(T + 1)
         c[T] = -1.0
@@ -339,7 +353,8 @@ def _leximin_relaxation(
             cand = np.array([int(np.argmax(y * m[uidx]))])
 
         conf = _marginal_probe_confirm(
-            reduction, fixed, z, uidx[cand], probe_tol, floor_slack=floor_slack
+            reduction, fixed, z, uidx[cand], probe_tol, floor_slack=floor_slack,
+            log=log,
         )
         probes += 1 + (0 if conf.all() else len(cand))
         confirmed = np.zeros(T, dtype=bool)
@@ -353,7 +368,7 @@ def _leximin_relaxation(
             for t in rest:
                 if _marginal_probe_confirm(
                     reduction, fixed, z, np.array([t]), probe_tol,
-                    floor_slack=floor_slack,
+                    floor_slack=floor_slack, log=log,
                 )[0]:
                     confirmed[t] = True
                     break
@@ -446,23 +461,29 @@ def _slice_relaxation(
     ncat = feat_of.shape[1]
     tidx = np.arange(T)
 
-    def swap_repair(c: np.ndarray, counts: np.ndarray, j: int) -> bool:
+    def swap_repair(c: np.ndarray, counts: np.ndarray, j: int, need: np.ndarray) -> bool:
         """Greedy best-swap quota repair, vectorized per iteration.
 
         Each pass scores every (donor, receiver) unit move by its exact
         violation change — per-type removal/addition effects from the
         feature-count deltas, with a correction for categories where donor
         and receiver share a feature (their effects cancel there) — and
-        applies a best strictly-improving swap, breaking the (ubiquitous)
-        integer ties *randomly per slice*: a deterministic best-swap makes
-        every repaired slice collapse onto the same few patterns, and the
-        hull diversity the decomposition master depends on disappears
-        (measured: support 87 vs 180 columns, ε 3.8e-2 vs 2.0e-2).
-        Replaces a python double loop that dominated the slicer's runtime
-        at T ≈ 800.
+        applies a best strictly-improving swap. Ties (ubiquitous on integer
+        scores) are broken by the slice's *tracking residual* ``c − need``
+        plus per-slice random noise: preferring donors above their stream
+        target and receivers below it means a repair corrects the
+        apportionment error instead of compounding it — repair drift, not
+        the ±1 rounding, is what set the decomposition's starting ε. Pure
+        random ties remain in the mix because fully deterministic repair
+        collapses slice diversity (measured: support 87 vs 180 columns,
+        ε 3.8e-2 vs 2.0e-2). Replaces a python double loop that dominated
+        the slicer's runtime at T ≈ 800.
         """
         tie = np.random.default_rng(j)
         for _ in range(3 * reduction.F):
+            track = np.clip(c - need, -2.0, 2.0)
+            pref_sub = -0.4 * track  # donate where above target ⇒ lower score
+            pref_add = 0.4 * track  # receive where below target ⇒ lower score
             viol = np.maximum(counts - hi, 0) + np.maximum(lo - counts, 0)
             total = int(viol.sum())
             if total == 0:
@@ -492,6 +513,25 @@ def _slice_relaxation(
                 receivers = np.nonzero(c < msize)[0]
             if len(donors) == 0 or len(receivers) == 0:
                 return False
+            # score the exact (donor, receiver) delta only on the most
+            # promising 16 per side (per-type scores + random tie noise):
+            # the full cross product over hundreds of types per pass was
+            # the slicer's dominant cost at T ≈ 800, and the best swap
+            # almost always lives among the top per-type scores
+            if len(donors) > 16:
+                donors = donors[
+                    np.argsort(
+                        dv_sub[donors] + pref_sub[donors] + tie.random(len(donors)) * 0.3
+                    )[:16]
+                ]
+            if len(receivers) > 16:
+                receivers = receivers[
+                    np.argsort(
+                        dv_add[receivers]
+                        + pref_add[receivers]
+                        + tie.random(len(receivers)) * 0.3
+                    )[:16]
+                ]
             delta = dv_sub[donors][:, None] + dv_add[receivers][None, :]
             # shared-feature correction: in a category where donor and
             # receiver have the same feature the move is a no-op there
@@ -502,7 +542,12 @@ def _slice_relaxation(
                     + dv_add_f[feat_of[receivers, ci]][None, :]
                 )
                 delta = delta - np.where(same, corr, 0)
-            noisy = delta + tie.random(delta.shape) * 0.9
+            noisy = (
+                delta
+                + pref_sub[donors][:, None]
+                + pref_add[receivers][None, :]
+                + tie.random(delta.shape) * 0.3
+            )
             di, ri = np.unravel_index(np.argmin(noisy), delta.shape)
             if delta[di, ri] >= 0:
                 return False
@@ -539,7 +584,7 @@ def _slice_relaxation(
             assigned += c  # feed back even on drop, keeping the stream honest
             continue
         counts = c @ tf
-        ok = swap_repair(c, counts, j)
+        ok = swap_repair(c, counts, j, need)
         assigned += c
         if ok:
             out.append(c.astype(np.int32))
@@ -767,6 +812,7 @@ def leximin_cg_typespace(
             cfg.decomp_accept,
             log=log,
             max_rounds=cfg.decomp_max_rounds,
+            cfg=cfg,
         )
         lp_solves += solves
     if eps_dev <= cfg.decomp_accept:
@@ -846,7 +892,9 @@ def leximin_cg_typespace(
             cand = unfixed_idx[y[unfixed_idx] > cfg.eps]
             if len(cand) == 0:
                 cand = unfixed_idx[[int(np.argmax(y[unfixed_idx]))]]
-            conf = _marginal_probe_confirm(reduction, fixed, z, cand, cfg.probe_tol)
+            conf = _marginal_probe_confirm(
+                reduction, fixed, z, cand, cfg.probe_tol, log=log
+            )
             newly = np.zeros(T, dtype=bool)
             newly[cand[conf]] = True
             if not newly.any():
@@ -953,6 +1001,23 @@ def leximin_cg_typespace(
                         z, y, mu, probs = _stage_lp(MT, fixed)
                     lp_solves += 1
                     pdhg_warm = None
+                    # the convergence certificate above priced against
+                    # PDHG-approximate duals; re-price once against the
+                    # authoritative optimum and keep generating if it still
+                    # finds an improving column — a stage must never be
+                    # declared converged on non-authoritative duals alone
+                    with log.timer("exact_oracle"):
+                        got = oracle.maximize(y / msize)
+                    exact_prices += 1
+                    if got is not None:
+                        best_comp, value = got
+                        if value > -mu + cfg.eps and add_comp(best_comp):
+                            log.emit(
+                                f"  stage {stages}: authoritative duals still "
+                                f"price an improving column (gap "
+                                f"{value + mu:.2e}); continuing."
+                            )
+                            continue
                 count = fix_tranche(z, y)
                 log.emit(
                     f"Fixed {count} type(s) "

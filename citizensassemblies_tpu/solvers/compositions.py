@@ -230,6 +230,7 @@ def leximin_over_compositions(
             conf = probe_confirm_tranche(
                 face_max, MT[unfixed[cand]], z, probe_tol,
                 slack_gain / msz[unfixed[cand]],
+                term_deficit=_SLACK, log=log.emit,
             )
             tranche[cand[conf]] = True
         # near-zero dual weight can still be degenerately tight everywhere —
@@ -237,11 +238,11 @@ def leximin_over_compositions(
         # only the ones sitting at z need a probe
         vals = MT[unfixed] @ np.maximum(res.x[:C], 0.0)
         for j in np.nonzero((y <= 1e-9) & (vals <= z + probe_tol))[0]:
-            got = face_max(MT[unfixed[j]])
-            if got == -np.inf or (
-                got is not None
-                and got <= z + probe_tol + slack_gain / float(msz[unfixed[j]])
-            ):
+            if probe_confirm_tranche(
+                face_max, MT[unfixed[j]][None, :], z, probe_tol,
+                np.array([slack_gain / float(msz[unfixed[j]])]),
+                term_deficit=_SLACK, log=log.emit,
+            )[0]:
                 tranche[j] = True
         if not tranche.any():
             tranche[np.argmax(y)] = True  # progress guard

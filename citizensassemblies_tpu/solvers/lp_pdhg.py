@@ -59,8 +59,13 @@ def _ruiz_equilibrate(K: jnp.ndarray, iters: int = 8) -> Tuple[jnp.ndarray, jnp.
     def body(_, carry):
         d_r, d_c = carry
         S = d_r[:, None] * K * d_c[None, :]
-        rn = jnp.sqrt(jnp.maximum(jnp.max(jnp.abs(S), axis=1), 1e-10))
-        cn = jnp.sqrt(jnp.maximum(jnp.max(jnp.abs(S), axis=0), 1e-10))
+        # all-zero rows/columns (bucket padding) keep scale 1: dividing by
+        # the clamped norm every sweep compounds to f32 overflow, and
+        # 0 × inf turns the whole scaled matrix into NaNs
+        rmax = jnp.max(jnp.abs(S), axis=1)
+        cmax = jnp.max(jnp.abs(S), axis=0)
+        rn = jnp.where(rmax > 0, jnp.sqrt(jnp.maximum(rmax, 1e-10)), 1.0)
+        cn = jnp.where(cmax > 0, jnp.sqrt(jnp.maximum(cmax, 1e-10)), 1.0)
         return d_r / rn, d_c / cn
 
     d_r, d_c = jax.lax.fori_loop(0, iters, body, (d_r, d_c))
@@ -79,9 +84,8 @@ def _power_norm(K: jnp.ndarray, iters: int = 40) -> jnp.ndarray:
     return jnp.sqrt(jnp.linalg.norm(K.T @ (K @ v)) + 1e-12)
 
 
-def _kkt_residual(c, G, h, A, b, x, lam, mu, scale):
-    """Combined relative KKT residual: primal infeasibility, dual
-    infeasibility, and duality gap, each normalized by problem scale."""
+def _kkt_parts(c, G, h, A, b, x, lam, mu):
+    """Primal infeasibility, dual infeasibility, and duality gap (absolute)."""
     pri_ineq = jnp.maximum(G @ x - h, 0.0)
     pri_eq = A @ x - b
     pri = jnp.sqrt(jnp.sum(pri_ineq**2) + jnp.sum(pri_eq**2))
@@ -91,6 +95,13 @@ def _kkt_residual(c, G, h, A, b, x, lam, mu, scale):
     pobj = c @ x
     dobj = -(lam @ h) - (mu @ b)
     gap = jnp.abs(pobj - dobj)
+    return pri, dua, gap, pobj, dobj
+
+
+def _kkt_residual(c, G, h, A, b, x, lam, mu, scale):
+    """Combined relative KKT residual: primal infeasibility, dual
+    infeasibility, and duality gap, each normalized by problem scale."""
+    pri, dua, gap, pobj, dobj = _kkt_parts(c, G, h, A, b, x, lam, mu)
     return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
 
 
@@ -109,8 +120,6 @@ def _pdhg_core(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: i
     As = Ks[m1:]
 
     norm = _power_norm(Ks)
-    tau = 0.9 / norm
-    sigma = 0.9 / norm
     scale = 1.0 + jnp.linalg.norm(cs) + jnp.linalg.norm(hs) + jnp.linalg.norm(bs)
 
     # map the (unscaled) warm start into scaled coordinates: x = D_c x̃ and
@@ -123,21 +132,39 @@ def _pdhg_core(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: i
         return _kkt_residual(cs, Gs, hs, As, bs, x, lam, mu, scale)
 
     def one_iter(carry, _):
-        x, lam, mu = carry
+        # running sums ride the carry: materializing the whole block
+        # trajectory (check_every × problem-size arrays) tripled the
+        # per-iteration HBM traffic for what is ultimately one mean
+        x, lam, mu, xs, ls, ms, tau, sigma = carry
         grad = cs + Gs.T @ lam + As.T @ mu
         x_new = jnp.maximum(x - tau * grad, 0.0)
         xb = 2.0 * x_new - x
         lam_new = jnp.maximum(lam + sigma * (Gs @ xb - hs), 0.0)
         mu_new = mu + sigma * (As @ xb - bs)
-        return (x_new, lam_new, mu_new), (x_new, lam_new, mu_new)
+        return (
+            x_new, lam_new, mu_new, xs + x_new, ls + lam_new, ms + mu_new,
+            tau, sigma,
+        ), None
 
     def block(state):
-        (x, lam, mu, x_av, lam_av, mu_av, it, res) = state
-        (x, lam, mu), traj = jax.lax.scan(one_iter, (x, lam, mu), None, length=check_every)
+        (x, lam, mu, x_av, lam_av, mu_av, it, res, omega) = state
+        # PDLP-style primal weight: τ = 0.9ω/‖K‖, σ = 0.9/(ω‖K‖) keeps the
+        # step-size product fixed (convergence guarantee) while ω balances
+        # primal vs dual progress — a fixed ω = 1 plateaus two orders above
+        # tolerance on the decomposition masters
+        tau = 0.9 * omega / norm
+        sigma = 0.9 / (omega * norm)
+        x_in, lam_in, mu_in = x, lam, mu
+        zero = (jnp.zeros_like(x), jnp.zeros_like(lam), jnp.zeros_like(mu))
+        (x, lam, mu, xs, ls, ms, _, _), _ = jax.lax.scan(
+            one_iter, (x, lam, mu) + zero + (tau, sigma), None,
+            length=check_every,
+        )
         # fresh running average over this block, blended with the carried one
-        xa = (x_av + jnp.mean(traj[0], axis=0)) * 0.5
-        la = (lam_av + jnp.mean(traj[1], axis=0)) * 0.5
-        ma = (mu_av + jnp.mean(traj[2], axis=0)) * 0.5
+        inv = 1.0 / check_every
+        xa = (x_av + xs * inv) * 0.5
+        la = (lam_av + ls * inv) * 0.5
+        ma = (mu_av + ms * inv) * 0.5
         r_cur = kkt(x, lam, mu)
         r_avg = kkt(xa, la, ma)
         # restart to the averaged iterate when it is strictly better
@@ -146,14 +173,28 @@ def _pdhg_core(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: i
         lam = jnp.where(better, la, lam)
         mu = jnp.where(better, ma, mu)
         res = jnp.minimum(r_cur, r_avg)
-        return (x, lam, mu, xa, la, ma, it + check_every, res)
+        # PDLP primal-weight update from the block's movement norms:
+        # ω ← sqrt(ω · ‖Δ(λ,μ)‖/‖Δx‖) (θ = ½ log-blend), clipped — when the
+        # duals move much more than the primal, shift step size toward the
+        # primal, and vice versa
+        dx = jnp.linalg.norm(x - x_in)
+        dy = jnp.sqrt(
+            jnp.sum((lam - lam_in) ** 2) + jnp.sum((mu - mu_in) ** 2)
+        )
+        moved = (dx > 1e-12) & (dy > 1e-12)
+        omega_new = jnp.sqrt(omega * jnp.clip(dy / jnp.maximum(dx, 1e-12), 1e-4, 1e4))
+        omega = jnp.where(moved, jnp.clip(omega_new, 1.0 / 64.0, 64.0), omega)
+        return (x, lam, mu, xa, la, ma, it + check_every, res, omega)
 
     def cond(state):
-        *_, it, res = state
+        x, lam, mu, xa, la, ma, it, res, omega = state
         return (res > tol) & (it < max_iters)
 
-    state0 = (x, lam, mu, x, lam, mu, jnp.int32(0), jnp.float32(jnp.inf))
-    x, lam, mu, _, _, _, it, res = jax.lax.while_loop(cond, block, state0)
+    state0 = (
+        x, lam, mu, x, lam, mu, jnp.int32(0), jnp.float32(jnp.inf),
+        jnp.float32(1.0),
+    )
+    x, lam, mu, _, _, _, it, res, _omega = jax.lax.while_loop(cond, block, state0)
 
     # unscale
     x_out = x * d_c

@@ -52,46 +52,75 @@ def robust_linprog(
     return last
 
 
+#: allowances beyond this are clamped before use: a certificate judged "up to
+#: the allowance" is only meaningful while the allowance stays well inside the
+#: framework's 1e-3 L∞ acceptance bar — an escalated slack ladder can push the
+#: raw slack-gain for a rare type to ~1e-2, and certifying at that tolerance
+#: would fix a genuinely loose type below its true leximin value.
+ALLOWANCE_CAP = 1e-4
+
+
 def probe_confirm_tranche(
     face_max: Callable[[np.ndarray], Optional[float]],
     objectives: np.ndarray,
     z: float,
     probe_tol: float,
     allowances: np.ndarray,
+    term_deficit: float = 0.0,
+    log: Optional[Callable[[str], object]] = None,
 ) -> np.ndarray:
     """Certify which leximin tranche candidates are capped at ``z`` over a
     stage's optimal face.
 
-    ``face_max(w)`` maximizes ``w`` over the face (every candidate's own value
-    is ≥ z there); ``objectives[i]`` is candidate i's value functional;
-    ``allowances[i]`` bounds the spurious headroom constraint slack can grant
-    candidate i (see the callers' slack-gain derivations). One group LP over
-    ``Σ objectives`` certifies every candidate at once when its optimum is
-    ``|cand|·z`` up to one shared tolerance — since each term is ≥ z on the
-    face, a sum bound of ``n·z + δ`` caps every single term at ``z + δ``;
-    per-candidate probes resolve disagreement. Returns a bool mask.
+    ``face_max(w)`` maximizes ``w`` over the face; ``objectives[i]`` is
+    candidate i's value functional; ``allowances[i]`` bounds the spurious
+    headroom constraint slack can grant candidate i (see the callers'
+    slack-gain derivations; clamped to :data:`ALLOWANCE_CAP` so a certificate
+    never exceeds a tolerance material against the 1e-3 bar);
+    ``term_deficit`` is how far below ``z`` a candidate's value may sit on the
+    face (the callers relax the face floors to ``z − margin − slack``, so each
+    term is only ≥ ``z − term_deficit`` there).
+
+    One group LP over ``Σ objectives`` certifies every candidate at once: a
+    sum bound of ``n·z + δ`` caps each term at ``z + δ + (n−1)·term_deficit``
+    (the other ``n−1`` terms can each sit ``term_deficit`` below ``z``), so
+    the group test passes only when ``δ ≤ probe_tol + min_allowance −
+    (n−1)·term_deficit`` — a budget that shrinks with tranche size and is
+    skipped when non-positive. Per-candidate probes resolve disagreement.
+
+    An *infeasible* face from the group probe is never taken as evidence of
+    tightness (this module's own header documents HiGHS falsely declaring
+    feasible LPs infeasible): it falls through to the per-candidate probes.
+    A per-candidate infeasible face does certify — the face provably contains
+    the just-computed stage optimum, so status-2 there means the solver's own
+    tolerance overstates ``z`` — but the event is logged so an
+    infeasibility-driven fix is visible in run logs. Any other solver failure
+    (``face_max`` None) certifies nothing. Returns a bool mask.
     """
     n = len(objectives)
     confirmed = np.zeros(n, dtype=bool)
     if n == 0:
         return confirmed
-    allowances = np.asarray(allowances, dtype=np.float64)
-    # An *infeasible* face (face_max -inf) means no point attains
-    # min ≥ z − slack: the solver-reported stage optimum z slightly
-    # overstates the true optimum (its own feasibility tolerance), so
-    # nothing can exceed z materially — certify rather than stall into the
-    # dual heuristic. Any other solver failure (face_max None) certifies
-    # nothing: a numerical breakdown is not evidence of tightness.
-    got = face_max(np.sum(objectives, axis=0))
-    if got == -np.inf or (
-        got is not None and got <= n * z + probe_tol + float(allowances.min())
-    ):
-        confirmed[:] = True
-        return confirmed
+    allowances = np.minimum(
+        np.asarray(allowances, dtype=np.float64), ALLOWANCE_CAP
+    )
+    group_budget = probe_tol + float(allowances.min()) - (n - 1) * term_deficit
+    if n > 1 and group_budget > 0.0:
+        got = face_max(np.sum(objectives, axis=0))
+        if got is not None and got != -np.inf and got <= n * z + group_budget:
+            confirmed[:] = True
+            return confirmed
+    infeasible_fixes = 0
     for i in range(n):
         got = face_max(objectives[i])
-        if got == -np.inf or (
-            got is not None and got <= z + probe_tol + float(allowances[i])
-        ):
+        if got == -np.inf:
             confirmed[i] = True
+            infeasible_fixes += 1
+        elif got is not None and got <= z + probe_tol + float(allowances[i]):
+            confirmed[i] = True
+    if infeasible_fixes and log is not None:
+        log(
+            f"  probe: {infeasible_fixes}/{n} candidate(s) certified via an "
+            f"infeasible probe face at z={z:.6f} (solver-tolerance overstatement)."
+        )
     return confirmed

@@ -97,9 +97,8 @@ def solve_final_primal_l2(
     mask = deficit > 0
     with np.errstate(divide="ignore", invalid="ignore"):
         ratios = np.where(mask & (gain > 0), deficit / gain, np.nan)
-    beta = float(np.nanmax(ratios)) if np.isfinite(np.nanmax(ratios)) else (
-        1.0 if mask.any() else 0.0
-    )
+    finite = ratios[np.isfinite(ratios)]
+    beta = float(finite.max()) if finite.size else (1.0 if mask.any() else 0.0)
     beta = min(max(beta, 0.0), 1.0)
     p = (1.0 - beta) * p + beta * p_lp
     return p, float(eps_star)
