@@ -391,16 +391,43 @@ def find_distribution_leximin(
                 ),
             )
         dual_warm = None
+        # stochastic pricing self-disables for the rest of a stage after two
+        # consecutive zero-yield batches: near stage convergence the sampler's
+        # violating-panel yield collapses while each batch still costs a full
+        # device (or, on CPU, host) sweep — at n=400 the dead batches were 98 %
+        # of the agent-space CG's wall-clock; the 12 ms exact oracle then
+        # carries the tail exactly as the reference's loop does
+        stochastic_fails = 0
         while True:
             P = portfolio.matrix()
+            authoritative = True  # sol comes from exact host HiGHS
             with log.timer("dual_lp"):
-                if cfg.backend == "jax":
+                if (
+                    cfg.backend != "highs"
+                    and jax.device_count() > 1
+                    and len(portfolio) >= cfg.dual_shard_min_rows
+                ):
+                    # portfolio outgrew one chip's sweet spot: mesh-sharded
+                    # device PDHG (rows over the mesh, psum-reduced
+                    # transposes); HiGHS only on non-convergence
+                    from citizensassemblies_tpu.parallel.mesh import default_mesh
+                    from citizensassemblies_tpu.parallel.solver import (
+                        solve_dual_lp_pdhg_sharded,
+                    )
+
+                    sol = solve_dual_lp_pdhg_sharded(P, fixed, default_mesh(), cfg=cfg)
+                    dual_warm = None
+                    authoritative = not sol.ok
+                    if not sol.ok:
+                        sol = solve_dual_lp(P, fixed)
+                elif cfg.backend == "jax":
                     # device PDHG, warm-started from the previous inner round
                     # (the portfolio only gains rows, so the old optimum is
                     # nearly feasible); HiGHS only on non-convergence
                     from citizensassemblies_tpu.solvers.lp_pdhg import solve_dual_lp_pdhg
 
                     sol, dual_warm = solve_dual_lp_pdhg(P, fixed, cfg=cfg, warm=dual_warm)
+                    authoritative = not sol.ok
                     if not sol.ok:
                         sol = solve_dual_lp(P, fixed)
                         dual_warm = None
@@ -418,19 +445,24 @@ def find_distribution_leximin(
 
             # fast path: batched stochastic pricing; add several violated
             # columns per LP solve
-            key, sub = jax.random.split(key)
-            with log.timer("stochastic_pricing"):
-                panels, values, ok = stochastic_price(dense, sol.y, sub, cfg=cfg, households=households)
-            new = best_violating_panels(
-                panels, values, ok, sol.yhat + cfg.eps, portfolio.seen,
-                max_new=cfg.cg_columns_per_round,
-            )
-            for panel, _val in new:
-                row = np.zeros(n, dtype=bool)
-                row[list(panel)] = True
-                portfolio.rows.append(row)
-            if new:
-                continue
+            if stochastic_fails < 2:
+                key, sub = jax.random.split(key)
+                with log.timer("stochastic_pricing"):
+                    panels, values, ok = stochastic_price(
+                        dense, sol.y, sub, cfg=cfg, households=households
+                    )
+                new = best_violating_panels(
+                    panels, values, ok, sol.yhat + cfg.eps, portfolio.seen,
+                    max_new=cfg.cg_columns_per_round,
+                )
+                for panel, _val in new:
+                    row = np.zeros(n, dtype=bool)
+                    row[list(panel)] = True
+                    portfolio.rows.append(row)
+                if new:
+                    stochastic_fails = 0
+                    continue
+                stochastic_fails += 1
 
             # certification: exact pricing oracle seeded at the dual cap —
             # "does any committee beat ŷ + EPS?" (leximin.py:420-431)
@@ -443,6 +475,20 @@ def find_distribution_leximin(
                 f"Gap {value - sol.yhat:.2%}."
             )
             if value <= sol.yhat + cfg.eps:
+                if not authoritative:
+                    # the convergence certificate priced against float32
+                    # PDHG duals; the irreversible fix below must come from
+                    # the exact host solve (same contract as the type-space
+                    # path) — and if the authoritative duals still price an
+                    # improving committee, keep generating instead
+                    sol_h = solve_dual_lp(P, fixed)
+                    if sol_h.ok:
+                        sol = sol_h
+                        with log.timer("exact_oracle"):
+                            panel, value = oracle.certify(sol.y, sol.yhat + cfg.eps)
+                        exact_prices += 1
+                        if value > sol.yhat + cfg.eps and portfolio.add(panel):
+                            continue
                 # portfolio supports an optimal solution: fix every unfixed
                 # agent with certifying dual weight (strict complementarity,
                 # leximin.py:431-443)

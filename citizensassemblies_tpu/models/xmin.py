@@ -102,6 +102,69 @@ def find_distribution_xmin(
     probs = np.clip(probs, 0.0, 1.0)
     probs = probs / probs.sum()
     allocation = P.T.astype(np.float64) @ probs
+
+    # 4) maximal uniform blend over the expansion panels, inside the L∞
+    #    budget. The dual-ascent spread degrades on strongly heterogeneous
+    #    instances (its step size collapses with the portfolio's column
+    #    sums), and the reference's own QP only trades spread against a
+    #    bounded ε (``xmin.py:447-455``). The mix
+    #    ``(1−γ)·p + γ·uniform(new panels)`` is the closed-form
+    #    support-maximal move: by convexity its allocation deviation is at
+    #    most ``(1−γ)·dev(p) + γ·dev(uniform)``, so γ is chosen — exact
+    #    arithmetic, no solver — as the largest weight keeping the deviation
+    #    within ``cfg.xmin_linf_band``; every expansion panel then carries
+    #    mass γ/|new| ≫ the support threshold.
+    if new_rows:
+        PT = P.T.astype(np.float64)
+        t = leximin.fixed_probabilities
+        band = cfg.xmin_linf_band
+        dev_l2 = float(np.abs(allocation - t).max())
+        if dev_l2 > 0.9 * band:
+            # the ascent's spread overshot the band (its step size collapses
+            # on heterogeneous portfolios): keep its iterate only as a
+            # *donor* and restart the shipped mixture from the leximin
+            # probabilities, whose deviation is the decomposition ε
+            p_l2 = probs
+            probs = np.zeros(P.shape[0])
+            probs[: leximin.committees.shape[0]] = leximin.probabilities
+            allocation = PT @ probs
+        else:
+            p_l2 = None
+        dev_now = float(np.abs(allocation - t).max())
+        # candidate donors: the L2 iterate (near-band deviation, broad
+        # support) and the uniform over expansion panels (guaranteed full
+        # expansion support, large deviation); for each, the largest blend
+        # weight γ with (1−γ)·dev_now + γ·dev_donor ≤ band — convexity makes
+        # the bound exact arithmetic — and keep the blend with the larger
+        # realized support
+        donors = [
+            np.concatenate(
+                [np.zeros(leximin.committees.shape[0]), np.full(len(new_rows), 1.0 / len(new_rows))]
+            )
+        ]
+        if p_l2 is not None:
+            donors.append(p_l2)
+        best = None
+        for q in donors:
+            dev_q = float(np.abs(PT @ q - t).max())
+            if dev_q <= band:
+                gamma = 1.0
+            elif dev_now < band:
+                gamma = (band - dev_now) / (dev_q - dev_now)
+            else:
+                continue
+            cand = (1.0 - gamma) * probs + gamma * q
+            support = int((cand > cfg.support_eps).sum())
+            if best is None or support > best[1]:
+                best = (cand, support, gamma)
+        if best is not None and best[1] > int((probs > cfg.support_eps).sum()):
+            probs, support, gamma = best
+            allocation = PT @ probs
+            log.emit(
+                f"XMIN spread: γ = {gamma:.4f} over {len(new_rows)} expansion "
+                f"panels → support {support} "
+                f"(L∞ dev {float(np.abs(allocation - t).max()):.2e} ≤ band {band:g})."
+            )
     log.emit(f"XMIN done: support {(probs > 1e-11).sum()} committees, ε = {eps_dev:.2e}.")
     return Distribution(
         committees=P,

@@ -1,21 +1,32 @@
-"""Mesh-sharded PDHG for the dual leximin LP.
+"""Mesh-sharded PDHG for the dual leximin LP — fully device-resident.
 
 At reference scale one chip holds the whole portfolio, but the framework's
 scaling axis is the portfolio/pool size (SURVEY §5 "long-context analog"):
 the dual LP's constraint matrix is the C×n committee matrix, and at large C
-its two GEMVs per PDHG iteration are the memory-bound hot loop. Here they
-run under ``shard_map`` with the portfolio rows laid out over the mesh
-(both mesh axes flattened into one row-parallel axis):
+its two GEMVs per PDHG iteration are the memory-bound hot loop. Here the
+*entire solve* — Ruiz equilibration, the ‖K‖ power estimate, the PDHG
+iteration loop and its KKT residual checks — runs in one jitted
+``shard_map`` with the portfolio rows laid out over the mesh (both mesh
+axes flattened into one row-parallel axis):
 
 * ``G x̄`` needs only local rows — no communication;
 * ``Gᵀ λ`` is a local [rows_local, n]ᵀ @ [rows_local] GEMV followed by one
-  ``psum`` over the mesh — the collective rides ICI.
+  ``psum`` over the mesh — the collective rides ICI;
+* column norms (Ruiz) and the dual-infeasibility reduction are ``pmax`` /
+  ``psum`` reductions of local partials.
 
+The host never touches the scaled matrix: it uploads the raw row shards
+once and receives scalars (residual, objective) plus the solution vectors.
 The primal iterate ``x`` and the equality dual ``μ`` stay replicated (they
-are n+1-sized — tiny); every device therefore computes identical updates
-from the psum-reduced gradient, so the sharded solve is deterministic and
-device-count-invariant. Scalings (Ruiz) and the step size are computed on
-host once per solve; convergence is checked between jitted blocks.
+are n+1-sized — tiny); every device computes identical updates from the
+psum-reduced gradient, so the sharded solve is deterministic and
+device-count-invariant.
+
+Production routing: ``find_distribution_leximin`` dispatches its dual solve
+here (``models/leximin.py``) whenever more than one device is visible and
+the portfolio has at least ``cfg.dual_shard_min_rows`` rows — the same LP
+otherwise solved by host HiGHS or single-device PDHG, so the fallback
+contract is unchanged (non-converged ⇒ host HiGHS).
 
 Exactness contract: same as the single-device PDHG — callers treat a
 non-converged result as "fall back to host HiGHS".
@@ -24,24 +35,140 @@ non-converged result as "fall back to host HiGHS".
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from citizensassemblies_tpu.solvers.highs_backend import DualSolution
 from citizensassemblies_tpu.utils.config import Config, default_config
 
 
-def _ruiz_host(K: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Host view of the shared Ruiz equilibration (``lp_pdhg._ruiz_equilibrate``)."""
-    from citizensassemblies_tpu.solvers.lp_pdhg import _ruiz_equilibrate
+def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
+    """Build the jitted, mesh-sharded PDHG solve for the dual-LP shape.
 
-    d_r, d_c = _ruiz_equilibrate(jnp.asarray(K, jnp.float32))
-    return np.asarray(d_r, np.float64), np.asarray(d_c, np.float64)
+    Everything runs on device inside one ``shard_map``: inputs are the raw
+    (unscaled) local row block ``G_l`` and the replicated problem vectors;
+    outputs are the solution and the final residual. Shapes are
+    (rows_local, n+1) per device.
+    """
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(), P(), P(), P()),
+        out_specs=(P(), P(axes), P(), P()),
+        check_vma=False,
+    )
+    def solve(G_l, c, a_row, b, tol):
+        f32 = jnp.float32
+        G_l = G_l.astype(f32)
+        c = c.astype(f32)
+        a_row = a_row.astype(f32)  # single equality row, replicated
+        nv = c.shape[0]
+
+        # ---- Ruiz equilibration, device-resident ------------------------
+        # row scalings are local; column norms need the cross-device max.
+        # The equality row keeps scale 1 (it is already unit-normed by
+        # construction); all-zero padding rows/columns keep scale 1 too.
+        def ruiz_body(_, carry):
+            d_r_l, d_c = carry
+            S = d_r_l[:, None] * G_l * d_c[None, :]
+            rmax = jnp.max(jnp.abs(S), axis=1)
+            cmax_l = jnp.max(jnp.abs(S), axis=0)
+            cmax = jax.lax.pmax(cmax_l, axes)
+            cmax = jnp.maximum(cmax, jnp.abs(a_row) * d_c)
+            rn = jnp.where(rmax > 0, jnp.sqrt(jnp.maximum(rmax, 1e-10)), 1.0)
+            cn = jnp.where(cmax > 0, jnp.sqrt(jnp.maximum(cmax, 1e-10)), 1.0)
+            return d_r_l / rn, d_c / cn
+
+        d_r_l, d_c = jax.lax.fori_loop(
+            0, 8, ruiz_body,
+            (jnp.ones(G_l.shape[0], f32), jnp.ones(nv, f32)),
+        )
+        Gs_l = d_r_l[:, None] * G_l * d_c[None, :]
+        cs = c * d_c
+        as_row = a_row * d_c
+        bs = b.astype(f32)
+
+        # ---- ‖K‖₂ power estimate, psum-reduced --------------------------
+        def pow_body(_, v):
+            u_l = Gs_l @ v
+            w = jax.lax.psum(Gs_l.T @ u_l, axes) + as_row * (as_row @ v)
+            return w / (jnp.linalg.norm(w) + 1e-12)
+
+        v = jax.lax.fori_loop(
+            0, 24, pow_body, jnp.ones(nv, f32) / jnp.sqrt(nv * 1.0)
+        )
+        u_l = Gs_l @ v
+        norm = jnp.sqrt(
+            jnp.linalg.norm(
+                jax.lax.psum(Gs_l.T @ u_l, axes) + as_row * (as_row @ v)
+            )
+            + 1e-12
+        )
+        tau = 0.9 / norm
+        sigma = 0.9 / norm
+        cnorm = jnp.linalg.norm(cs)
+        scale = 1.0 + cnorm + jnp.abs(bs[0])
+
+        def kkt(x, lam_l, mu):
+            # hs is all zeros by construction (dual-LP rows are P y ≤ ŷ)
+            pri_l = jnp.sum(jnp.maximum(Gs_l @ x, 0.0) ** 2)
+            pri = jnp.sqrt(jax.lax.psum(pri_l, axes) + (as_row @ x - bs[0]) ** 2)
+            grad = cs + jax.lax.psum(Gs_l.T @ lam_l, axes) + as_row * mu[0]
+            dua = jnp.linalg.norm(jnp.minimum(grad, 0.0))
+            pobj = cs @ x
+            dobj = -(mu[0] * bs[0])
+            gap = jnp.abs(pobj - dobj)
+            return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+
+        def one_iter(carry, _):
+            x, lam_l, mu, xs, ls, ms = carry
+            grad = cs + jax.lax.psum(Gs_l.T @ lam_l, axes) + as_row * mu[0]
+            x_new = jnp.maximum(x - tau * grad, 0.0)
+            xb = 2.0 * x_new - x
+            lam_l = jnp.maximum(lam_l + sigma * (Gs_l @ xb), 0.0)
+            mu = mu + sigma * (jnp.array([as_row @ xb]) - bs)
+            return (x_new, lam_l, mu, xs + x_new, ls + lam_l, ms + mu), None
+
+        def block(state):
+            x, lam_l, mu, xa, la, ma, it, res = state
+            zero = (jnp.zeros_like(x), jnp.zeros_like(lam_l), jnp.zeros_like(mu))
+            (x, lam_l, mu, xs, ls, ms), _ = jax.lax.scan(
+                one_iter, (x, lam_l, mu) + zero, None, length=block_iters
+            )
+            inv = 1.0 / block_iters
+            xa = (xa + xs * inv) * 0.5
+            la = (la + ls * inv) * 0.5
+            ma = (ma + ms * inv) * 0.5
+            r_cur = kkt(x, lam_l, mu)
+            r_avg = kkt(xa, la, ma)
+            better = r_avg < r_cur
+            x = jnp.where(better, xa, x)
+            lam_l = jnp.where(better, la, lam_l)
+            mu = jnp.where(better, ma, mu)
+            return (x, lam_l, mu, xa, la, ma, it + 1, jnp.minimum(r_cur, r_avg))
+
+        def cond(state):
+            *_, it, res = state
+            return (res > tol[0]) & (it < max_blocks)
+
+        x0 = jnp.zeros(nv, f32)
+        lam0 = jnp.zeros(G_l.shape[0], f32)
+        mu0 = jnp.zeros(1, f32)
+        state = (x0, lam0, mu0, x0, lam0, mu0, jnp.int32(0), jnp.float32(jnp.inf))
+        x, lam_l, mu, _, _, _, _it, res = jax.lax.while_loop(cond, block, state)
+        # unscale on device; λ rescaling is local to each shard
+        return x * d_c, lam_l * d_r_l, mu, jnp.array([res])
+
+    return solve
+
+
+_CORE_CACHE: dict = {}
 
 
 def solve_dual_lp_pdhg_sharded(
@@ -50,10 +177,11 @@ def solve_dual_lp_pdhg_sharded(
     mesh: Mesh,
     cfg: Optional[Config] = None,
     tol: Optional[float] = None,
-    max_blocks: int = 60,
+    max_blocks: int = 120,
     block_iters: int = 512,
 ) -> DualSolution:
-    """Dual leximin LP (``leximin.py:300-328``) with mesh-sharded GEMVs.
+    """Dual leximin LP (``leximin.py:300-328``) with a mesh-sharded,
+    device-resident PDHG.
 
     Variables ``z = [y (n), ŷ]``; ``min ŷ − Σ fixedᵢ yᵢ`` s.t.
     ``P y − ŷ·1 ≤ 0``, ``Σ_unfixed y = 1``, ``z ≥ 0``. Returns the standard
@@ -61,7 +189,7 @@ def solve_dual_lp_pdhg_sharded(
     """
     cfg = cfg or default_config()
     tol = float(cfg.pdhg_tol if tol is None else tol)
-    P_mat = np.asarray(P_mat, dtype=np.float64)
+    P_mat = np.asarray(P_mat, dtype=np.float32)
     C, n = P_mat.shape
     ndev = mesh.devices.size
     fixed = np.asarray(fixed, dtype=np.float64)
@@ -70,89 +198,32 @@ def solve_dual_lp_pdhg_sharded(
 
     # pad rows to a device multiple; a zero row adds ŷ ≥ 0 (already implied)
     rows = -(-C // ndev) * ndev
-    G = np.zeros((rows, n + 1))
+    G = np.zeros((rows, n + 1), dtype=np.float32)
     G[:C, :n] = P_mat
     G[:, n] = -1.0
-    h = np.zeros(rows)
-    A = np.concatenate([unfixed.astype(np.float64), [0.0]])[None, :]
+    a_row = np.concatenate([unfixed.astype(np.float64), [0.0]])
     b = np.array([1.0])
     c = np.concatenate([-fixed_vals, [1.0]])
 
-    K = np.concatenate([G, A], axis=0)
-    d_r, d_c = _ruiz_host(K)
-    Ks = K * d_r[:, None] * d_c[None, :]
-    cs = c * d_c
-    hs = h * d_r[:rows]
-    bs = b * d_r[rows:]
-    Gs = Ks[:rows]
-    As = Ks[rows:]
-    # ‖K‖₂ by host power iteration
-    x = np.random.default_rng(0).standard_normal(n + 1)
-    for _ in range(20):
-        x = Ks.T @ (Ks @ x)
-        x /= np.linalg.norm(x) + 1e-30
-    norm = float(np.linalg.norm(Ks @ x))
-    tau = sigma = 0.9 / max(norm, 1e-12)
-    scale = 1.0 + float(np.linalg.norm(cs) + np.linalg.norm(hs) + np.linalg.norm(bs))
+    axes = mesh.axis_names
+    key = (mesh, axes, block_iters, max_blocks)
+    core = _CORE_CACHE.get(key)
+    if core is None:
+        core = _sharded_core(mesh, axes, block_iters, max_blocks)
+        _CORE_CACHE[key] = core
 
-    axes = mesh.axis_names  # both flattened into one row-parallel axis
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P(axes), P(axes), P(), P()),
-        out_specs=(P(), P(axes), P()),
-        check_vma=False,
+    # upload the raw row shards once; all scaling happens on device
+    G_dev = jax.device_put(G, NamedSharding(mesh, P(axes, None)))
+    x, lam, mu, res = core(
+        G_dev,
+        jnp.asarray(c, jnp.float32),
+        jnp.asarray(a_row, jnp.float32),
+        jnp.asarray(b, jnp.float32),
+        jnp.asarray([tol], jnp.float32),
     )
-    def block(G_l, lam_l, x, mu):
-        G_l = G_l.astype(jnp.float32)
-        h_l = jnp.zeros(G_l.shape[0], jnp.float32)  # hs is all zeros by construction
-
-        def one_iter(carry, _):
-            x, lam_l, mu = carry
-            gT = jax.lax.psum(G_l.T @ lam_l, axes)
-            grad = cs_d + gT + As_d[0] * mu[0]
-            x_new = jnp.maximum(x - tau * grad, 0.0)
-            xb = 2.0 * x_new - x
-            lam_l = jnp.maximum(lam_l + sigma * (G_l @ xb - h_l), 0.0)
-            mu = mu + sigma * (As_d @ xb - bs_d)
-            return (x_new, lam_l, mu), None
-
-        (x, lam_l, mu), _ = jax.lax.scan(
-            one_iter, (x, lam_l, mu), None, length=block_iters
-        )
-        return x, lam_l, mu
-
-    cs_d = jnp.asarray(cs, jnp.float32)
-    As_d = jnp.asarray(As, jnp.float32)
-    bs_d = jnp.asarray(bs, jnp.float32)
-    tau = jnp.float32(tau)
-    sigma = jnp.float32(sigma)
-
-    x = np.zeros(n + 1, dtype=np.float32)
-    lam = np.zeros(rows, dtype=np.float32)
-    mu = np.zeros(1, dtype=np.float32)
-    Gs_dev = jnp.asarray(Gs.astype(np.float32))  # upload the matrix once
-    res = np.inf
-    it = 0
-    for _ in range(max_blocks):
-        x, lam, mu = block(Gs_dev, jnp.asarray(lam), jnp.asarray(x), jnp.asarray(mu))
-        x, lam, mu = np.asarray(x), np.asarray(lam), np.asarray(mu)
-        it += block_iters
-        # host KKT residual (same combined form as the single-device core)
-        primal = max(
-            float(np.maximum(Gs @ x - hs, 0.0).max(initial=0.0)),
-            float(np.abs(As @ x - bs).max(initial=0.0)),
-        )
-        dual = float(np.maximum(-(cs + Gs.T @ lam + As.T @ mu), 0.0).max(initial=0.0))
-        gap = abs(float(cs @ x + hs @ lam + bs @ mu))
-        res = (primal + dual + gap / scale) / 1.0
-        if res <= tol * 4.0:
-            break
-
-    # unscale
-    z = x * d_c
-    y = z[:n].astype(np.float64)
-    yhat = float(z[n])
-    objective = float(c @ (x * d_c))
-    return DualSolution(ok=bool(res <= tol * 4.0), y=y, yhat=yhat, objective=objective)
+    x = np.asarray(x, dtype=np.float64)
+    res_f = float(np.asarray(res)[0])
+    y = x[:n]
+    yhat = float(x[n])
+    objective = float(c @ x)
+    return DualSolution(ok=bool(res_f <= tol * 4.0), y=y, yhat=yhat, objective=objective)

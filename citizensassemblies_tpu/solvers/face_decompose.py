@@ -181,7 +181,7 @@ def _master_pdhg(
     warm,
     max_iters: int,
     tol: float,
-) -> Tuple[float, np.ndarray, np.ndarray, float, tuple]:
+) -> Tuple[float, np.ndarray, np.ndarray, float, Optional[tuple], bool]:
     """One approximate master solve on device: the two-sided ε-LP of
     ``cg_typespace._decomp_lp`` handed to the warm-started PDHG core.
 
@@ -390,7 +390,11 @@ def realize_profile(
                         f"end-game polish)."
                     )
                     return C_sup, p_sup, eps_sup, lp_solves
-                eps = min(eps, eps_sup)
+                # discard the failed polish value: it is the optimum of a
+                # support SUBSET, not something the full-column iterate
+                # attains — mixing it into eps/eps_hist/best would make the
+                # stall detector and the best-hull tracker compare
+                # incommensurable quantities
                 polish_after = rnd + 2
         else:
             eps, w, _mu, p = _decomp_lp(MT, v)

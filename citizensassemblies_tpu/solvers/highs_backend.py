@@ -499,3 +499,82 @@ def solve_final_primal_lp(P: np.ndarray, target: np.ndarray) -> Tuple[np.ndarray
     if res.status != 0 or res.x is None:
         raise SelectionError(f"final primal LP failed (HiGHS status {res.status}: {res.message})")
     return res.x[:C], float(max(res.x[C], 0.0))
+
+
+def audit_maximin(
+    dense, allocation: np.ndarray, covered: Optional[np.ndarray] = None
+) -> dict:
+    """Solver-independent post-hoc maximin certificate for an allocation.
+
+    Plays the role Gurobi's dual-gap certificate plays on every reference run
+    (``leximin.py:429-431``), applied after the fact to whatever produced
+    ``allocation``: by LP minimax duality, for ANY probability vector ``w``
+    over agents, ``maximin ≤ Σ_i w_i · alloc_i ≤ max_{feasible committee x}
+    w·x``, and the right-hand maximum is evaluated by the exact agent-space
+    HiGHS MILP — so the resulting bound is a valid certificate regardless of
+    where ``w`` came from. The witness used is the floor-dual vector of the
+    stage-1 maximin LP over the marginal polytope (one tiny host HiGHS LP),
+    which is tight when the allocation is exact.
+
+    ``covered`` masks agents contained in some feasible committee: agents
+    provably in none have probability 0 under every distribution (the
+    reference excludes them from the optimization, ``leximin.py:286-296``),
+    so the maximin claim — and its witness floors — range over coverable
+    agents only.
+
+    Returns ``{"achieved_min", "certified_maximin_upper", "maximin_gap"}`` —
+    a gap within the framework's 1e-3 tolerance certifies the first leximin
+    level of ``allocation`` independently of the type-space machinery.
+    """
+    from citizensassemblies_tpu.solvers.lp_util import robust_linprog
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+    red = TypeReduction(dense)
+    T, F = red.T, red.F
+    m = red.msize.astype(np.float64)
+    if covered is None:
+        covered = np.ones(dense.n, dtype=bool)
+    covered = np.asarray(covered, dtype=bool)
+    # a type is coverable iff any member is
+    cov_t = np.zeros(T, dtype=bool)
+    np.logical_or.at(cov_t, red.type_id, covered)
+    tf = np.zeros((T, F))
+    for t in range(T):
+        tf[t, red.type_feature[t]] = 1.0
+    # stage-1 maximin LP over the marginal polytope: vars [x (T), z];
+    # floors only on coverable types
+    c = np.zeros(T + 1)
+    c[T] = -1.0
+    A_ub = np.zeros((2 * F + T, T + 1))
+    A_ub[:F, :T] = -tf.T
+    A_ub[F : 2 * F, :T] = tf.T
+    A_ub[2 * F + np.arange(T), np.arange(T)] = -1.0
+    A_ub[2 * F :, T] = np.where(cov_t, m, 0.0)
+    b_ub = np.concatenate(
+        [-red.qmin.astype(float), red.qmax.astype(float), np.zeros(T)]
+    )
+    A_eq = np.concatenate([np.ones(T), [0.0]])[None, :]
+    res = robust_linprog(
+        c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=[float(red.k)],
+        bounds=[(0, mm) for mm in m] + [(0, None)],
+    )
+    if res.status != 0:
+        raise SelectionError(f"maximin witness LP failed: {res.message}")
+    y_t = np.maximum(-np.asarray(res.ineqlin.marginals)[2 * F :], 0.0)
+    w = np.where(cov_t, y_t, 0.0)[red.type_id]
+    total = w.sum()
+    if total <= 0:  # degenerate dual (z unbounded below floor rows) — uniform
+        w = np.full(dense.n, 1.0 / dense.n)
+    else:
+        w = w / total
+    # exact agent-space bound; the MILP path is used directly because the
+    # witness is constant within types, a regime where the seeded native
+    # B&B ties itself in near-equal branches while HiGHS solves instantly
+    oracle = HighsCommitteeOracle(dense)
+    _panel, upper = oracle._milp_maximize(w)
+    z_min = float(np.asarray(allocation)[covered].min())
+    return {
+        "achieved_min": round(z_min, 6),
+        "certified_maximin_upper": round(float(upper), 6),
+        "maximin_gap": round(float(upper) - z_min, 6),
+    }

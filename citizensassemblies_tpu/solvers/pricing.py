@@ -54,6 +54,12 @@ def stochastic_price(
     """
     cfg = cfg or default_config()
     B = batch or cfg.pricing_batch
+    if batch is None and jax.default_backend() == "cpu":
+        # a pricing batch exists to surface ~cg_columns_per_round violating
+        # panels per LP solve; on an accelerator 4096 chains cost the same
+        # as 1024, but on the CPU backend the sweep is serial and the
+        # oversized batch was the agent-space CG's dominant cost
+        B = min(B, 1024)
     w = jnp.asarray(weights, dtype=jnp.float32)
     scores = _pricing_scores(w, B)
     panels, ok = sample_panels_batch(dense, key, B, scores=scores, households=households)

@@ -40,16 +40,23 @@ def project_simplex(v: jnp.ndarray) -> jnp.ndarray:
 
 @partial(jax.jit, static_argnames=("iters",))
 def _min_norm_dual_ascent(P, t, eps, lr, iters: int):
+    """Two-sided dual ascent: multipliers on BOTH ``Pᵀp ≥ t − ε`` and
+    ``Pᵀp ≤ t + ε``. One-sided floors let the spread re-route surplus mass
+    upward — on heterogeneous instances the overshoot concentrated several
+    ×ε on individual agents, breaking the XMIN contract that per-agent
+    probabilities stay at their leximin values."""
     C, n = P.shape
-    lam0 = jnp.zeros((n,), dtype=P.dtype)
+    lam0 = jnp.zeros((2 * n,), dtype=P.dtype)
 
     def p_of(lam):
-        return project_simplex((P @ lam) / 2.0)
+        return project_simplex((P @ (lam[:n] - lam[n:])) / 2.0)
 
     def body(_, lam):
         p = p_of(lam)
-        resid = (t - eps) - P.T @ p  # violated ⇒ positive ⇒ raise λ
-        return jnp.maximum(lam + lr * resid, 0.0)
+        alloc = P.T @ p
+        resid_lo = (t - eps) - alloc  # violated ⇒ positive ⇒ raise λ_lo
+        resid_up = alloc - (t + eps)  # violated ⇒ positive ⇒ raise λ_up
+        return jnp.maximum(lam + lr * jnp.concatenate([resid_lo, resid_up]), 0.0)
 
     lam = jax.lax.fori_loop(0, iters, body, lam0)
     return p_of(lam)

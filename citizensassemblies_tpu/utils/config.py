@@ -113,6 +113,11 @@ class Config:
     #: attempts to sample a panel not already in the portfolio, as a multiple
     #: of n (reference ``xmin.py:466``).
     xmin_dedup_attempts_factor: int = 3
+    #: L∞ budget for XMIN's support-maximizing blend: per-agent probabilities
+    #: must stay within this of their leximin values after the spread (the
+    #: framework's acceptance bar is 1e-3; the margin absorbs the leximin
+    #: stage's own realization ε).
+    xmin_linf_band: float = 8e-4
 
     # --- PDHG LP solver -------------------------------------------------------
     #: KKT tolerance for the device PDHG LP solver — 1e-6 is near the float32
@@ -123,6 +128,14 @@ class Config:
     #: both the current and the averaged iterate), so checking every 64 was
     #: ~20 % of the whole solve
     pdhg_check_every: int = 128
+
+    #: route the agent-space dual LP through the mesh-sharded device PDHG
+    #: (``parallel/solver.py``) whenever more than one device is visible and
+    #: the portfolio has at least this many rows — the regime where the C×n
+    #: committee matrix outgrows one chip's comfortable working set and the
+    #: GEMVs want the mesh (SURVEY §5 long-context analog). Below it the
+    #: host/single-device solvers win on latency.
+    dual_shard_min_rows: int = 4_096
 
     # --- backends -------------------------------------------------------------
     #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),

@@ -4,6 +4,7 @@ layout (filenames/CSV schemas from ``reference_output/``)."""
 
 import csv
 import pickle
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -243,3 +244,69 @@ def test_cli_address_columns_households(tmp_path, monkeypatch):
             continue
         members = np.nonzero(row)[0]
         assert len(set(hh[members].tolist())) == len(members)
+
+
+def test_golden_statistics_numeric_diff(example_small, tmp_path):
+    """Field-parse the generated ``example_small_20_statistics.txt`` and
+    assert each numeric line against the golden
+    ``reference_output/example_small_20_statistics.txt`` within stated
+    tolerances — exact (LEXIMIN) lines within 1e-3, Monte-Carlo (LEGACY)
+    lines within sampling noise (VERDICT r2 item #7, replacing the previous
+    existence/schema checks with a value-level regression)."""
+    import re
+
+    from citizensassemblies_tpu.analysis.report import analyze_instance
+
+    golden_path = Path("/root/reference/reference_output/example_small_20_statistics.txt")
+    if not golden_path.exists():
+        pytest.skip("golden statistics not mounted")
+
+    result = analyze_instance(
+        example_small,
+        out_dir=tmp_path / "analysis",
+        cache_dir=tmp_path / "distributions",
+        skip_timing=True,
+        echo=False,
+    )
+    ours = (tmp_path / "analysis" / "example_small_20_statistics.txt").read_text(
+        encoding="utf-8"
+    )
+
+    def field(text: str, label: str) -> float:
+        """First percentage following ``label`` in ``text``."""
+        m = re.search(re.escape(label) + r"[^\d≤]*≤?\s*([\d.]+)%", text)
+        assert m, f"statistics line not found: {label!r}"
+        return float(m.group(1))
+
+    golden = golden_path.read_text(encoding="utf-8")
+    # (label, abs tolerance in percentage points, reason)
+    checks = [
+        ("mean selection probability k/n:", 0.05, "arithmetic"),
+        ("LEXIMIN minimum probability (exact):", 0.1, "exact to 1e-3"),
+        ("gini coefficient of LEXIMIN:", 0.1, "exact to 1e-3"),
+        ("geometric mean of LEXIMIN:", 0.1, "exact to 1e-3"),
+        ("LEGACY minimum probability:", 0.8, "Jeffreys UCB of a 10k-draw MC"),
+        ("gini coefficient of LEGACY:", 0.8, "10k-draw MC estimate"),
+        ("geometric mean of LEGACY:", 0.5, "10k-draw MC estimate"),
+        # knife-edge statistic: counts agents whose MC estimate falls below
+        # the exact leximin minimum, which here sits at the centre of the
+        # sampling distribution — the reference's own two seeds differ
+        # visibly on it
+        (
+            "share selected by LEGACY with probability below LEXIMIN minimum "
+            "selection probability:",
+            15.0,
+            "MC knife-edge",
+        ),
+    ]
+    for label, tol, reason in checks:
+        got = field(ours, label)
+        want = field(golden, label)
+        assert abs(got - want) <= tol, (
+            f"{label} {got}% vs golden {want}% (tol {tol}pp, {reason})"
+        )
+    # structural integers must match exactly
+    for label in ("pool size n:", "panel size k:", "# quota categories:"):
+        got_m = re.search(re.escape(label) + r"\s*(\d+)", ours)
+        want_m = re.search(re.escape(label) + r"\s*(\d+)", golden)
+        assert got_m and want_m and got_m.group(1) == want_m.group(1), label
