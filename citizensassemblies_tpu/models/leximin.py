@@ -482,13 +482,28 @@ def find_distribution_leximin(
                     # path) — and if the authoritative duals still price an
                     # improving committee, keep generating instead
                     sol_h = solve_dual_lp(P, fixed)
-                    if sol_h.ok:
-                        sol = sol_h
-                        with log.timer("exact_oracle"):
-                            panel, value = oracle.certify(sol.y, sol.yhat + cfg.eps)
-                        exact_prices += 1
-                        if value > sol.yhat + cfg.eps and portfolio.add(panel):
-                            continue
+                    if not sol_h.ok:
+                        # never fix from unverified f32 duals: take the
+                        # reference's numerical-failure recovery instead
+                        # (shave fixed probabilities and retry,
+                        # leximin.py:405-417)
+                        fixed = np.where(
+                            fixed >= 0,
+                            np.maximum(fixed - cfg.fixed_prob_relax_step, 0.0),
+                            fixed,
+                        )
+                        reduction_counter += 1
+                        log.emit(
+                            "Authoritative dual re-solve not optimal — reduced "
+                            f"fixed probabilities (reduction {reduction_counter})."
+                        )
+                        continue
+                    sol = sol_h
+                    with log.timer("exact_oracle"):
+                        panel, value = oracle.certify(sol.y, sol.yhat + cfg.eps)
+                    exact_prices += 1
+                    if value > sol.yhat + cfg.eps and portfolio.add(panel):
+                        continue
                 # portfolio supports an optimal solution: fix every unfixed
                 # agent with certifying dual weight (strict complementarity,
                 # leximin.py:431-443)

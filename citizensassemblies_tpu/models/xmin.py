@@ -44,16 +44,23 @@ def find_distribution_xmin(
     cfg: Optional[Config] = None,
     households: Optional[np.ndarray] = None,
     log: Optional[RunLog] = None,
+    leximin: Optional[Distribution] = None,
 ) -> Distribution:
     """Compute the XMIN distribution: leximin-optimal per-agent probabilities
-    over an expanded, support-maximized portfolio."""
+    over an expanded, support-maximized portfolio.
+
+    ``leximin`` optionally supplies a precomputed LEXIMIN distribution for
+    the same (dense, cfg, households) problem, skipping step 1 — callers
+    that already hold one (the analysis cache, benchmarks) avoid a duplicate
+    full solve."""
     cfg = cfg or default_config()
     log = log or RunLog(echo=False)
 
     # 1) exact leximin (fixes every agent's probability; xmin.py:506-508)
-    leximin = find_distribution_leximin(
-        dense, space, cfg=cfg, households=households, log=log
-    )
+    if leximin is None:
+        leximin = find_distribution_leximin(
+            dense, space, cfg=cfg, households=households, log=log
+        )
     n = dense.n
 
     # 2) portfolio expansion: the reference draws up to 5n fresh LEGACY panels

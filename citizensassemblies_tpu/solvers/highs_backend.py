@@ -563,8 +563,12 @@ def audit_maximin(
     y_t = np.maximum(-np.asarray(res.ineqlin.marginals)[2 * F :], 0.0)
     w = np.where(cov_t, y_t, 0.0)[red.type_id]
     total = w.sum()
-    if total <= 0:  # degenerate dual (z unbounded below floor rows) — uniform
-        w = np.full(dense.n, 1.0 / dense.n)
+    if total <= 0:
+        # degenerate dual (no active floor rows): fall back to the uniform
+        # witness over COVERED agents only — mass on a non-coverable agent
+        # (whose allocation is structurally 0) would deflate the bound below
+        # the true maximin and falsely certify
+        w = covered.astype(np.float64) / covered.sum()
     else:
         w = w / total
     # exact agent-space bound; the MILP path is used directly because the
