@@ -203,17 +203,20 @@ def main() -> None:
 
         from citizensassemblies_tpu.core.generator import (
             cca_skewed_instance,
+            nexus_skewed_instance,
             obf_skewed_instance,
             sf_e_skewed_instance,
         )
 
-        # regime sweep (VERDICT r2 item #6): the two hardest remaining
-        # baseline shapes — cca_75 (n=825, 4 cats, strongly heterogeneous)
-        # and obf_30 (n=321, 8 cats). Real pools withheld; baselines are the
-        # reference timings on the real instances, marked estimated.
+        # regime sweep (VERDICT r2 item #6): the hardest remaining baseline
+        # shapes — cca_75 (n=825, 4 cats, strongly heterogeneous), obf_30
+        # (n=321, 8 cats) and nexus_170 (n=342, k=170: the high-selection-
+        # ratio regime). Real pools withheld; baselines are the reference
+        # timings on the real instances, marked estimated.
         for name, builder, base in (
             ("cca_skewed_75", cca_skewed_instance, 433.5),
             ("obf_skewed_30", obf_skewed_instance, 183.9),
+            ("nexus_skewed_170", nexus_skewed_instance, 83.4),
         ):
             d2, s2 = featurize(builder())
             t0 = time.time()

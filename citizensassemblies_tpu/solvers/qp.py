@@ -77,10 +77,15 @@ def solve_final_primal_l2(
 
     Pj = jnp.asarray(P, dtype=jnp.float32)
     tj = jnp.asarray(target, dtype=jnp.float32)
-    # dual-gradient Lipschitz constant ≈ ||P||² / 2; bound via row/col sums
-    k = float(np.max(P.sum(axis=1)))
-    Cn = float(np.max(P.sum(axis=0)))
-    L = max(k * Cn / 2.0, 1.0)
+    # dual-gradient Lipschitz constant = σ_max(P)²/2, estimated by power
+    # iteration (shared with the PDHG core): the closed-form bound
+    # max_row_sum · max_col_sum / 2 overestimates σ² by orders of magnitude
+    # on expanded portfolios (thousands of panels all containing the popular
+    # agents), making the ascent step so small the spread never moved
+    from citizensassemblies_tpu.solvers.lp_pdhg import _power_norm
+
+    sigma_sq = float(_power_norm(Pj)) ** 2
+    L = max(sigma_sq / 2.0, 1.0)
     p = _min_norm_dual_ascent(Pj, tj, jnp.float32(eps), jnp.float32(1.0 / L), iters)
     p = np.asarray(p, dtype=np.float64)
     p = np.clip(p, 0.0, 1.0)
