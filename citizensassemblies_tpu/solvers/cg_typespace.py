@@ -557,6 +557,8 @@ def _slice_relaxation(
             counts += tf[tr] - tf[td]
         return bool(np.all(counts >= lo) and np.all(counts <= hi))
 
+    from citizensassemblies_tpu.solvers.native_oracle import repair_slice_native
+
     out: List[np.ndarray] = []
     for j in range(1, R + 1):
         need = j * x - assigned
@@ -584,7 +586,19 @@ def _slice_relaxation(
             assigned += c  # feed back even on drop, keeping the stream honest
             continue
         counts = c @ tf
-        ok = swap_repair(c, counts, j, need)
+        # the repair loop is the slicer's host hot spot (tens of passes per
+        # slice of small-array work): the native C++ implementation runs the
+        # identical scoring ~100× faster; the python path remains as the
+        # fallback when the toolchain is unavailable
+        c32 = np.ascontiguousarray(c, dtype=np.int32)
+        cnt32 = np.ascontiguousarray(counts, dtype=np.int32)
+        ok = repair_slice_native(
+            reduction, c32, cnt32, need, seed=j, max_passes=3 * reduction.F
+        )
+        if ok is None:
+            ok = swap_repair(c, counts, j, need)
+        else:
+            c[:] = c32
         assigned += c
         if ok:
             out.append(c.astype(np.int32))

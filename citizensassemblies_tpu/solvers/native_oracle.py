@@ -35,6 +35,23 @@ _lib = None
 _lib_failed = False
 
 
+def _compile_and_load(src: str, so: str) -> ctypes.CDLL:
+    """g++-compile ``src`` into ``so`` when stale and load it (raises on any
+    toolchain failure — callers convert that to a None / fallback)."""
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        os.makedirs(os.path.dirname(so), exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", so],
+            check=True,
+            capture_output=True,
+        )
+    return ctypes.CDLL(so)
+
+
+def _ptr(a: np.ndarray, t):
+    return a.ctypes.data_as(ctypes.POINTER(t))
+
+
 def _load() -> Optional[ctypes.CDLL]:
     """Compile (once) and load the shared library; None if unavailable."""
     global _lib, _lib_failed
@@ -42,14 +59,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-                os.makedirs(os.path.dirname(_SO), exist_ok=True)
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO],
-                    check=True,
-                    capture_output=True,
-                )
-            lib = ctypes.CDLL(_SO)
+            lib = _compile_and_load(_SRC, _SO)
             lib.bb_price.restype = ctypes.c_int
             lib.bb_price.argtypes = [
                 ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -145,15 +155,14 @@ def price_exact(
     out_value = ctypes.c_double(0.0)
     out_nodes = ctypes.c_int64(0)
 
-    def p(a, t):
-        return a.ctypes.data_as(ctypes.POINTER(t))
-
     status = lib.bb_price(
         reduction.T, reduction.n_cats, reduction.F,
-        p(tf, ctypes.c_int32), p(msize, ctypes.c_int32), p(prefix_c, ctypes.c_double),
-        reduction.maxm, p(lo, ctypes.c_int32), p(hi, ctypes.c_int32),
+        _ptr(tf, ctypes.c_int32), _ptr(msize, ctypes.c_int32),
+        _ptr(prefix_c, ctypes.c_double),
+        reduction.maxm, _ptr(lo, ctypes.c_int32), _ptr(hi, ctypes.c_int32),
         reduction.k, float(incumbent), int(max_nodes),
-        p(out_counts, ctypes.c_int32), ctypes.byref(out_value), ctypes.byref(out_nodes),
+        _ptr(out_counts, ctypes.c_int32), ctypes.byref(out_value),
+        ctypes.byref(out_nodes),
     )
     if status == 0:
         if out_counts[0] == -1 and np.all(out_counts == -1):
@@ -166,3 +175,69 @@ def price_exact(
         committee = tuple(sorted(int(i) for i in members))
         return committee, float(out_value.value)
     return None  # status 1 (infeasible unseeded), 2 (node limit), 3 (bad args)
+
+
+# --- native slice repair (the aimed slicer's host hot loop) -----------------
+
+_REPAIR_SRC = os.path.join(_REPO_ROOT, "native", "slice_repair.cpp")
+_REPAIR_SO = os.path.join(_REPO_ROOT, "native", "build", "libslice_repair.so")
+_repair_lib = None
+_repair_failed = False
+
+
+def _load_repair() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the slice-repair library; None if unavailable."""
+    global _repair_lib, _repair_failed
+    with _lock:
+        if _repair_lib is not None or _repair_failed:
+            return _repair_lib
+        try:
+            lib = _compile_and_load(_REPAIR_SRC, _REPAIR_SO)
+            lib.slice_repair.restype = ctypes.c_int
+            lib.slice_repair.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),  # type_feature
+                ctypes.POINTER(ctypes.c_int32),  # msize
+                ctypes.POINTER(ctypes.c_int32),  # lo
+                ctypes.POINTER(ctypes.c_int32),  # hi
+                ctypes.POINTER(ctypes.c_int32),  # c
+                ctypes.POINTER(ctypes.c_int32),  # counts
+                ctypes.POINTER(ctypes.c_double),  # need
+                ctypes.c_uint32, ctypes.c_int,
+            ]
+            _repair_lib = lib
+        except Exception:
+            _repair_failed = True
+            _repair_lib = None
+        return _repair_lib
+
+
+def repair_slice_native(
+    reduction: "TypeReduction",
+    c: np.ndarray,
+    counts: np.ndarray,
+    need: np.ndarray,
+    seed: int,
+    max_passes: int,
+) -> Optional[bool]:
+    """Native greedy quota repair of one apportionment slice (mutates ``c``
+    and ``counts`` in place — same scoring as the python ``swap_repair``
+    fallback in ``cg_typespace._slice_relaxation``, ~100× faster at
+    T ≈ 1000). Returns None when the library is unavailable."""
+    lib = _load_repair()
+    if lib is None:
+        return None
+    tf = np.ascontiguousarray(reduction.type_feature, dtype=np.int32)
+    msize = np.ascontiguousarray(reduction.msize, dtype=np.int32)
+    lo = np.ascontiguousarray(reduction.qmin, dtype=np.int32)
+    hi = np.ascontiguousarray(reduction.qmax, dtype=np.int32)
+    need = np.ascontiguousarray(need, dtype=np.float64)
+    ok = lib.slice_repair(
+        reduction.T, reduction.n_cats, reduction.F,
+        _ptr(tf, ctypes.c_int32), _ptr(msize, ctypes.c_int32),
+        _ptr(lo, ctypes.c_int32), _ptr(hi, ctypes.c_int32),
+        _ptr(c, ctypes.c_int32), _ptr(counts, ctypes.c_int32),
+        _ptr(need, ctypes.c_double),
+        ctypes.c_uint32(seed & 0xFFFFFFFF), int(max_passes),
+    )
+    return bool(ok)
