@@ -255,10 +255,13 @@ def _typespace_leximin(
                 budget=cfg.decompose_budget,
                 support_eps=cfg.support_eps,
                 log=log,
-                # enumerated path stays machine-exact; the CG path floors the
-                # panel tolerance at 2e-5 (its greedy noise scale) — total
-                # error ts.eps + 2e-5 stays far under the 1e-3 bar
-                tol=max(1e-9 if comps is not None else 2e-5, getattr(ts, "eps_dev", 0.0)),
+                # enumerated path polishes to 1e-6 (500× below the
+                # reference's own EPS=5e-4 final-LP tolerance — chasing
+                # 1e-9 cost ~30 extra host LPs for precision nothing
+                # downstream can see); the CG path floors the panel
+                # tolerance at 2e-5 (its greedy noise scale) — total error
+                # ts.eps + tol stays far under the 1e-3 bar either way
+                tol=max(1e-6 if comps is not None else 2e-5, getattr(ts, "eps_dev", 0.0)),
             )
     probs = np.clip(probs, 0.0, 1.0)
     keep = probs > cfg.support_eps

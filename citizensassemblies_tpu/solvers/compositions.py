@@ -392,7 +392,8 @@ def decompose_with_pricing(
 
     from citizensassemblies_tpu.solvers.highs_backend import solve_final_primal_lp_duals
 
-    add_per_round = 32
+    add_per_round = 64  # closed-form pricing is ~free; bigger rounds halve
+    # the number of host LP solves, which are the loop's whole cost
     p = None
     eps_dev = 1.0
     for _ in range(max_rounds):

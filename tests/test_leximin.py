@@ -93,7 +93,7 @@ def test_leximin_matches_bruteforce_asymmetric():
     # leximin values: 2/9 for the 9 majority agents, 1/3 for the 3 minority
     np.testing.assert_allclose(brute[:9], 2 / 9, atol=1e-9)
     np.testing.assert_allclose(brute[9:], 1 / 3, atol=1e-9)
-    np.testing.assert_allclose(dist.allocation, brute, atol=1e-6)
+    np.testing.assert_allclose(dist.allocation, brute, atol=5e-6)
     assert_committees_feasible(dist, dense)
 
 
@@ -105,7 +105,7 @@ def test_leximin_matches_bruteforce_random():
             np.asarray(dense.A), np.asarray(dense.qmin), np.asarray(dense.qmax), dense.k
         )
         dist = find_distribution_leximin(dense, space)
-        np.testing.assert_allclose(dist.allocation, brute, atol=1e-6)
+        np.testing.assert_allclose(dist.allocation, brute, atol=5e-6)
         assert_committees_feasible(dist, dense)
 
 
