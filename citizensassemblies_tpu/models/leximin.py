@@ -259,9 +259,15 @@ def _typespace_leximin(
                 # reference's own EPS=5e-4 final-LP tolerance — chasing
                 # 1e-9 cost ~30 extra host LPs for precision nothing
                 # downstream can see); the CG path floors the panel
-                # tolerance at 2e-5 (its greedy noise scale) — total error
-                # ts.eps + tol stays far under the 1e-3 bar either way
-                tol=max(1e-6 if comps is not None else 2e-5, getattr(ts, "eps_dev", 0.0)),
+                # tolerance at 2e-5 (its greedy noise scale) and at HALF
+                # the mixture's own ε — the total contract error is
+                # |alloc − v| ≤ tol_panel + eps_dev, so the ½ factor caps
+                # the worst case at 1.5·decomp_accept ≈ 9.8e-4 < 1e-3
+                # (a floor of eps_dev itself would allow 2·eps_dev = 1.3e-3)
+                tol=max(
+                    1e-6 if comps is not None else 2e-5,
+                    0.5 * getattr(ts, "eps_dev", 0.0),
+                ),
             )
     probs = np.clip(probs, 0.0, 1.0)
     keep = probs > cfg.support_eps

@@ -227,18 +227,12 @@ def repair_slice_native(
     lib = _load_repair()
     if lib is None:
         return None
-    # per-reduction constants, converted once and cached: this function runs
-    # once per slice inside the loop it exists to speed up
-    cached = getattr(reduction, "_repair_i32", None)
-    if cached is None:
-        cached = (
-            np.ascontiguousarray(reduction.type_feature, dtype=np.int32),
-            np.ascontiguousarray(reduction.msize, dtype=np.int32),
-            np.ascontiguousarray(reduction.qmin, dtype=np.int32),
-            np.ascontiguousarray(reduction.qmax, dtype=np.int32),
-        )
-        reduction._repair_i32 = cached
-    tf, msize, lo, hi = cached
+    # TypeReduction stores these contiguous int32 already, so the casts are
+    # zero-copy views — no per-slice conversion cost
+    tf = np.ascontiguousarray(reduction.type_feature, dtype=np.int32)
+    msize = np.ascontiguousarray(reduction.msize, dtype=np.int32)
+    lo = np.ascontiguousarray(reduction.qmin, dtype=np.int32)
+    hi = np.ascontiguousarray(reduction.qmax, dtype=np.int32)
     need = np.ascontiguousarray(need, dtype=np.float64)
     ok = lib.slice_repair(
         reduction.T, reduction.n_cats, reduction.F,

@@ -1,0 +1,79 @@
+"""Randomized exactness fuzz over the certified LEXIMIN pipeline.
+
+The targeted tests pin specific regimes (tight quotas, heavy skew, n=400
+agent-space cross-check); this harness sweeps a batch of random heterogeneous
+instances through the full production path and checks, on every one, the
+invariants that make the solver's output trustworthy:
+
+* every support panel satisfies every quota and has exactly k members;
+* the allocation realizes the probe-certified leximin profile within the
+  framework's 1e-3 L∞ contract (``Config.decomp_accept`` + panel tolerance);
+* total allocation mass is exactly k (Σ over agents of selection probability);
+* the solver-independent maximin audit (``highs_backend.audit_maximin`` — an
+  exact agent-space HiGHS MILP against a maximin witness, the post-hoc role
+  of the reference's per-run Gurobi dual-gap certificate,
+  ``leximin.py:429-431``) confirms the first leximin level.
+
+Catching the rare numerical branches (slack-ladder escalation, face-stall
+fallback, infeasible-probe logging) requires breadth more than depth — this
+is the breadth.
+"""
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import skewed_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.solvers.highs_backend import audit_maximin
+
+CASES = [
+    # (n, k, n_categories, features_per_category, seed, skew)
+    (120, 15, 3, [2, 3, 4], 11, 0.5),
+    (180, 20, 4, [2, 2, 3, 5], 12, 0.8),
+    (250, 18, 5, [2, 3, 3, 2, 4], 13, 1.0),
+    (300, 45, 4, [3, 4, 2, 3], 14, 0.6),
+    (220, 30, 6, [2, 2, 2, 3, 3, 4], 15, 0.9),
+    (160, 80, 3, [2, 4, 3], 16, 0.7),  # high selection ratio (nexus-like)
+    (90, 8, 2, [2, 2], 17, 1.0),  # tiny panel, few types (enumerated path)
+    (140, 70, 4, [2, 3, 2, 2], 18, 0.4),  # k = n/2
+    (350, 12, 5, [4, 3, 5, 2, 3], 19, 1.0),  # small panel, many cells
+    (200, 25, 7, [2, 2, 3, 2, 4, 2, 3], 20, 0.8),  # many categories
+    (260, 40, 3, [5, 6, 4], 21, 0.9),  # wide categories
+    (110, 100, 2, [2, 3], 22, 0.3),  # near-total selection (k ≈ n)
+]
+
+
+@pytest.mark.parametrize("n,k,ncat,fpc,seed,skew", CASES)
+def test_fuzz_leximin_certified_invariants(n, k, ncat, fpc, seed, skew):
+    inst = skewed_instance(
+        n=n, k=k, n_categories=ncat, features_per_category=fpc,
+        seed=seed, skew=skew,
+    )
+    dense, space = featurize(inst)
+    dist = find_distribution_leximin(dense, space)
+
+    # panel feasibility of the whole support
+    qmin, qmax = dense.qmin_np, dense.qmax_np
+    A = dense.A_np
+    support = 0
+    for row, p in zip(dist.committees, dist.probabilities):
+        if p <= 1e-11:
+            continue
+        support += 1
+        assert row.sum() == k
+        counts = A[row].sum(axis=0)
+        assert np.all(counts >= qmin) and np.all(counts <= qmax)
+    assert support >= 1
+
+    # allocation realizes the certified profile within the L∞ contract
+    assert abs(float(dist.allocation.sum()) - k) < 1e-6
+    dev = float(np.abs(dist.allocation - dist.fixed_probabilities).max())
+    assert dev <= 1e-3, f"L∞ dev {dev:.2e} breaks the 1e-3 contract"
+
+    # solver-independent first-level certificate
+    audit = audit_maximin(dense, dist.allocation, dist.covered)
+    assert audit["maximin_gap"] <= 1.5e-3, audit
+    assert (
+        audit["certified_maximin_upper"] >= audit["achieved_min"] - 1e-9
+    ), audit
