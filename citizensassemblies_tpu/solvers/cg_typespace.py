@@ -216,9 +216,17 @@ def _marginal_probe_confirm(
     k = float(reduction.k)
     quota_A, quota_b = _quota_system(reduction)
     unfixed = fixed < 0
+    # the stage LP's unfixed floors are EXACT (x_u ≥ z·m_u rows, no slack),
+    # so its optimum provably lies on the face with floors z − probe_relax
+    # for any probe_relax > 0 — only solver feasibility tolerance needs
+    # covering, not the fixing margin. A loose face (the old margin+slack
+    # relaxation) freed (margin+slack)·Σm ≈ 1e-4-scale reroutable mass,
+    # which made every sound group-probe budget negative and degraded
+    # tranche certification to one LP per candidate.
+    probe_relax = max(1e-8, floor_slack)
     lo = np.where(
         unfixed,
-        np.maximum(z - _FIX_MARGIN - floor_slack, 0.0) * m,
+        np.maximum(z - probe_relax, 0.0) * m,
         (np.maximum(fixed, 0.0) - floor_slack) * m,
     )
     lo = np.clip(lo, 0.0, m)
@@ -239,16 +247,18 @@ def _marginal_probe_confirm(
         # candidate is trivially capped at z — no LP needed, and the face at
         # z ≈ 1 is often numerically empty anyway
         return np.ones(len(cand), dtype=bool)
-    # the face floors are relaxed by (margin + slack)·m_t raw units each; at
-    # most their sum can be re-routed into a candidate, so tightness must be
-    # judged up to that freed mass (normalized by m_t) or genuinely tight
-    # types probe "loose" on large pools, inflating later stage values by
-    # exactly the slack (the shared prober clamps the allowance so an
-    # escalated slack ladder can never certify at a tolerance material
-    # against the 1e-3 bar); each candidate's own value may also sit up to
-    # margin + slack below z on the face, which the prober charges against
-    # the group test's budget
-    slack_gain = (_FIX_MARGIN + floor_slack) * float(m.sum())
+    # the face floors are relaxed by probe_relax·m_t (unfixed) and
+    # floor_slack·m_t (fixed) raw units; at most their sum can be re-routed
+    # into a candidate, so tightness must be judged up to that freed mass
+    # (normalized by m_t) or genuinely tight types probe "loose" on large
+    # pools, inflating later stage values by exactly the slack (the shared
+    # prober clamps the allowance so an escalated slack ladder can never
+    # certify at a tolerance material against the 1e-3 bar); each
+    # candidate's own value may also sit up to probe_relax below z on the
+    # face, which the prober charges against the group test's budget
+    slack_gain = probe_relax * float(m[unfixed].sum()) + floor_slack * float(
+        m[~unfixed].sum()
+    )
     objectives = np.zeros((len(cand), T))
     objectives[np.arange(len(cand)), cand] = 1.0 / m[cand]
     return probe_confirm_tranche(
@@ -257,7 +267,7 @@ def _marginal_probe_confirm(
         z,
         probe_tol,
         slack_gain / m[cand],
-        term_deficit=_FIX_MARGIN + floor_slack,
+        term_deficit=probe_relax,
         log=log.emit if log is not None else None,
     )
 

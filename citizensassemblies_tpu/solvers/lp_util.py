@@ -81,21 +81,28 @@ def probe_confirm_tranche(
     face (the callers relax the face floors to ``z − margin − slack``, so each
     term is only ≥ ``z − term_deficit`` there).
 
-    One group LP over ``Σ objectives`` certifies every candidate at once: a
-    sum bound of ``n·z + δ`` caps each term at ``z + δ + (n−1)·term_deficit``
-    (the other ``n−1`` terms can each sit ``term_deficit`` below ``z``), so
-    the group test passes only when ``δ ≤ probe_tol + min_allowance −
-    (n−1)·term_deficit`` — a budget that shrinks with tranche size and is
-    skipped when non-positive. Per-candidate probes resolve disagreement.
+    Group LPs certify many candidates per solve: a sum bound of ``g·z + δ``
+    over a chunk caps each member at ``z + δ + (g−1)·term_deficit`` (the
+    other members can each sit ``term_deficit`` below ``z``), and since the
+    face's freed slack can concentrate on ONE member, ``δ`` must absorb the
+    chunk's LARGEST allowance — sound only when every member's own
+    allowance covers it. Chunks therefore group candidates of equal
+    allowance (≈ equal pool size), sized so the ``(g−1)·term_deficit``
+    inflation stays immaterial; per-candidate probes resolve disagreement.
 
-    An *infeasible* face from the group probe is never taken as evidence of
+    An *infeasible* face from a group probe is never taken as evidence of
     tightness (this module's own header documents HiGHS falsely declaring
     feasible LPs infeasible): it falls through to the per-candidate probes.
-    A per-candidate infeasible face does certify — the face provably contains
-    the just-computed stage optimum, so status-2 there means the solver's own
-    tolerance overstates ``z`` — but the event is logged so an
-    infeasibility-driven fix is visible in run logs. Any other solver failure
-    (``face_max`` None) certifies nothing. Returns a bool mask.
+    A per-candidate infeasible face certifies only after the face itself is
+    confirmed non-empty (one zero-objective feasibility solve, cached per
+    tranche): on a non-empty face, status-2 for a bounded objective is a
+    solver mis-report best read as "nothing exceeds z materially", and the
+    event is logged. If the face is genuinely empty — the reported ``z``
+    overstates the true stage optimum by more than the face relaxation —
+    nothing is certified: an empty face carries no tightness information,
+    and falsely confirming would fix loose candidates at an understated
+    value. Any other solver failure (``face_max`` None) certifies nothing.
+    Returns a bool mask.
     """
     n = len(objectives)
     confirmed = np.zeros(n, dtype=bool)
@@ -104,20 +111,72 @@ def probe_confirm_tranche(
     allowances = np.minimum(
         np.asarray(allowances, dtype=np.float64), ALLOWANCE_CAP
     )
-    group_budget = probe_tol + float(allowances.min()) - (n - 1) * term_deficit
-    if n > 1 and group_budget > 0.0:
-        got = face_max(np.sum(objectives, axis=0))
-        if got is not None and got != -np.inf and got <= n * z + group_budget:
-            confirmed[:] = True
-            return confirmed
+
     infeasible_fixes = 0
-    for i in range(n):
+    face_state = {"checked": False, "empty": False}
+
+    def probe_one(i: int) -> None:
+        nonlocal infeasible_fixes
         got = face_max(objectives[i])
         if got == -np.inf:
+            if not face_state["checked"]:
+                face_state["checked"] = True
+                z0 = face_max(np.zeros_like(objectives[i]))
+                face_state["empty"] = z0 == -np.inf
+                if face_state["empty"] and log is not None:
+                    log(
+                        f"  probe: face at z={z:.6f} is empty (reported stage "
+                        "optimum overstates the true one beyond the face "
+                        "relaxation) — certifying nothing."
+                    )
+            if face_state["empty"]:
+                return
             confirmed[i] = True
             infeasible_fixes += 1
         elif got is not None and got <= z + probe_tol + float(allowances[i]):
             confirmed[i] = True
+
+    # Chunked group probing over EQUAL-allowance groups. The sound bound for
+    # a chunk probe: constraint slack lets the whole tranche's freed mass
+    # concentrate on ONE member, so a passing sum certifies each member only
+    # at ``z + probe_tol + max_allow(chunk) + (g−1)·term_deficit`` — usable
+    # only when every member's own allowance covers ``max_allow``, i.e. when
+    # the chunk's allowances are (near-)identical. Allowances are
+    # ``slack_gain / m_t`` with small-integer ``m_t``, so grouping by exact
+    # allowance value yields ~#distinct-pool-sizes probes per tranche
+    # instead of one per candidate; chunk size is additionally capped so the
+    # ``(g−1)·term_deficit`` inflation stays immaterial (≤ 10·probe_tol).
+    order = np.argsort(-allowances)
+    max_infl = 10.0 * probe_tol
+    i = 0
+    while i < n:
+        j = i + 1
+        a_i = float(allowances[order[i]])
+        while (
+            j < n
+            and j - i < 256
+            and abs(float(allowances[order[j]]) - a_i) <= 1e-12
+            and (j - i) * term_deficit <= max_infl
+        ):
+            j += 1
+        chunk = order[i:j]
+        if len(chunk) == 1:
+            probe_one(int(chunk[0]))
+        else:
+            g = len(chunk)
+            got = face_max(np.sum(objectives[chunk], axis=0))
+            if (
+                got is not None
+                and got != -np.inf
+                and got <= g * z + probe_tol + a_i
+            ):
+                confirmed[chunk] = True
+            else:
+                # disagreement (or an infeasible/failed group face): resolve
+                # candidate by candidate within this chunk only
+                for idx in chunk:
+                    probe_one(int(idx))
+        i = j
     if infeasible_fixes and log is not None:
         log(
             f"  probe: {infeasible_fixes}/{n} candidate(s) certified via an "
