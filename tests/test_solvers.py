@@ -250,3 +250,74 @@ def test_native_slice_repair_matches_python_fallback(monkeypatch):
     # ballpark (both repair the same near-feasible stream)
     assert native_n >= 0.7 * python_n
     assert python_n >= 0.7 * native_n
+
+
+def test_probe_confirm_tranche_chunks_equal_allowances():
+    """Equal-allowance candidates are certified in chunked group probes (one
+    LP per pool-size class), not one LP per candidate — the regression that
+    degraded relaxation certification to ~1000 LPs per stage."""
+    from citizensassemblies_tpu.solvers.lp_util import probe_confirm_tranche
+
+    n = 100
+    z = 0.5
+    calls = {"n": 0}
+    objectives = np.eye(n)
+
+    def face_max(w):
+        calls["n"] += 1
+        # every candidate is exactly tight at z on this synthetic face
+        return float(w.sum()) * z
+
+    allowances = np.full(n, 1e-5)  # one allowance class
+    conf = probe_confirm_tranche(
+        face_max, objectives, z, probe_tol=1e-7, allowances=allowances,
+        term_deficit=1e-8,
+    )
+    assert conf.all()
+    assert calls["n"] <= 2, f"expected ~1 group probe, saw {calls['n']}"
+
+    # two allowance classes ⇒ at most two group probes
+    calls["n"] = 0
+    allowances = np.concatenate([np.full(50, 1e-5), np.full(50, 2e-5)])
+    conf = probe_confirm_tranche(
+        face_max, objectives, z, probe_tol=1e-7, allowances=allowances,
+        term_deficit=1e-8,
+    )
+    assert conf.all()
+    assert calls["n"] <= 3
+
+
+def test_probe_confirm_tranche_empty_face_certifies_nothing():
+    """A genuinely empty probe face (reported z overstating the true stage
+    optimum beyond the face relaxation) must certify NOTHING — previously it
+    silently confirmed every candidate, fixing loose types low."""
+    from citizensassemblies_tpu.solvers.lp_util import probe_confirm_tranche
+
+    logged = []
+    conf = probe_confirm_tranche(
+        lambda w: -np.inf,  # every solve reports infeasible, incl. w = 0
+        np.eye(4), 0.5, probe_tol=1e-7, allowances=np.full(4, 1e-6),
+        term_deficit=1e-8, log=logged.append,
+    )
+    assert not conf.any()
+    assert any("empty" in line for line in logged)
+
+
+def test_probe_confirm_tranche_spurious_infeasible_still_certifies():
+    """A solver mis-report (per-candidate objective claims infeasible while
+    the zero-objective feasibility solve proves the face non-empty) keeps the
+    documented certify-with-log behavior."""
+    from citizensassemblies_tpu.solvers.lp_util import probe_confirm_tranche
+
+    def face_max(w):
+        if not w.any():  # feasibility probe: the face is non-empty
+            return 0.0
+        return -np.inf  # mis-reported objective solves
+
+    logged = []
+    conf = probe_confirm_tranche(
+        face_max, np.eye(3), 0.5, probe_tol=1e-7,
+        allowances=np.full(3, 1e-6), term_deficit=1e-8, log=logged.append,
+    )
+    assert conf.all()
+    assert any("infeasible probe face" in line for line in logged)
