@@ -259,14 +259,20 @@ def _typespace_leximin(
                 # reference's own EPS=5e-4 final-LP tolerance — chasing
                 # 1e-9 cost ~30 extra host LPs for precision nothing
                 # downstream can see); the CG path floors the panel
-                # tolerance at 2e-5 (its greedy noise scale) and at HALF
-                # the mixture's own ε — the total contract error is
-                # |alloc − v| ≤ tol_panel + eps_dev, so the ½ factor caps
-                # the worst case at 1.5·decomp_accept ≈ 9.8e-4 < 1e-3
-                # (a floor of eps_dev itself would allow 2·eps_dev = 1.3e-3)
+                # tolerance at 2e-5 (its greedy noise scale) and otherwise
+                # budgets it against the mixture's own ε: the total
+                # contract error is |alloc − v| ≤ tol_panel + eps_dev ≤
+                # accept_band + 1e-4 (= 9e-4 < 1e-3 at the default config;
+                # derived from cfg so the knobs cannot silently drift past
+                # the contract)
                 tol=max(
                     1e-6 if comps is not None else 2e-5,
-                    0.5 * getattr(ts, "eps_dev", 0.0),
+                    min(
+                        0.5 * getattr(ts, "eps_dev", 0.0),
+                        max(cfg.decomp_accept, cfg.decomp_accept_stalled)
+                        + 1e-4
+                        - getattr(ts, "eps_dev", 0.0),
+                    ),
                 ),
             )
     probs = np.clip(probs, 0.0, 1.0)
