@@ -59,13 +59,14 @@ def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axes, None), P(), P(), P(), P()),
+        in_specs=(P(axes, None), P(axes), P(), P(), P(), P()),
         out_specs=(P(), P(axes), P(), P()),
         check_vma=False,
     )
-    def solve(G_l, c, a_row, b, tol):
+    def solve(G_l, h_l, c, a_row, b, tol):
         f32 = jnp.float32
         G_l = G_l.astype(f32)
+        h_l = h_l.astype(f32)  # local slice of the inequality offsets
         c = c.astype(f32)
         a_row = a_row.astype(f32)  # single equality row, replicated
         nv = c.shape[0]
@@ -90,6 +91,7 @@ def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
             (jnp.ones(G_l.shape[0], f32), jnp.ones(nv, f32)),
         )
         Gs_l = d_r_l[:, None] * G_l * d_c[None, :]
+        hs_l = h_l * d_r_l
         cs = c * d_c
         as_row = a_row * d_c
         bs = b.astype(f32)
@@ -113,16 +115,16 @@ def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
         tau = 0.9 / norm
         sigma = 0.9 / norm
         cnorm = jnp.linalg.norm(cs)
-        scale = 1.0 + cnorm + jnp.abs(bs[0])
+        hnorm = jnp.sqrt(jax.lax.psum(jnp.sum(hs_l**2), axes))
+        scale = 1.0 + cnorm + hnorm + jnp.abs(bs[0])
 
         def kkt(x, lam_l, mu):
-            # hs is all zeros by construction (dual-LP rows are P y ≤ ŷ)
-            pri_l = jnp.sum(jnp.maximum(Gs_l @ x, 0.0) ** 2)
+            pri_l = jnp.sum(jnp.maximum(Gs_l @ x - hs_l, 0.0) ** 2)
             pri = jnp.sqrt(jax.lax.psum(pri_l, axes) + (as_row @ x - bs[0]) ** 2)
             grad = cs + jax.lax.psum(Gs_l.T @ lam_l, axes) + as_row * mu[0]
             dua = jnp.linalg.norm(jnp.minimum(grad, 0.0))
             pobj = cs @ x
-            dobj = -(mu[0] * bs[0])
+            dobj = -jax.lax.psum(lam_l @ hs_l, axes) - mu[0] * bs[0]
             gap = jnp.abs(pobj - dobj)
             return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
 
@@ -131,7 +133,7 @@ def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
             grad = cs + jax.lax.psum(Gs_l.T @ lam_l, axes) + as_row * mu[0]
             x_new = jnp.maximum(x - tau * grad, 0.0)
             xb = 2.0 * x_new - x
-            lam_l = jnp.maximum(lam_l + sigma * (Gs_l @ xb), 0.0)
+            lam_l = jnp.maximum(lam_l + sigma * (Gs_l @ xb - hs_l), 0.0)
             mu = mu + sigma * (jnp.array([as_row @ xb]) - bs)
             return (x_new, lam_l, mu, xs + x_new, ls + lam_l, ms + mu), None
 
@@ -171,6 +173,41 @@ def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
 _CORE_CACHE: dict = {}
 
 
+def _run_core(
+    mesh: Mesh,
+    G: np.ndarray,
+    h: np.ndarray,
+    c: np.ndarray,
+    a_row: np.ndarray,
+    b: np.ndarray,
+    tol: float,
+    block_iters: int,
+    max_blocks: int,
+):
+    """Shared marshalling for the sharded PDHG core: cache the shard_map
+    program per (mesh, block schedule), upload the row shards, run."""
+    axes = mesh.axis_names
+    key = (mesh, axes, block_iters, max_blocks)
+    core = _CORE_CACHE.get(key)
+    if core is None:
+        core = _sharded_core(mesh, axes, block_iters, max_blocks)
+        _CORE_CACHE[key] = core
+    G_dev = jax.device_put(
+        np.asarray(G, np.float32), NamedSharding(mesh, P(axes, None))
+    )
+    h_dev = jax.device_put(
+        np.asarray(h, np.float32), NamedSharding(mesh, P(axes))
+    )
+    return core(
+        G_dev,
+        h_dev,
+        jnp.asarray(c, jnp.float32),
+        jnp.asarray(a_row, jnp.float32),
+        jnp.asarray(b, jnp.float32),
+        jnp.asarray([tol], jnp.float32),
+    )
+
+
 def solve_dual_lp_pdhg_sharded(
     P_mat: np.ndarray,
     fixed: np.ndarray,
@@ -205,21 +242,9 @@ def solve_dual_lp_pdhg_sharded(
     b = np.array([1.0])
     c = np.concatenate([-fixed_vals, [1.0]])
 
-    axes = mesh.axis_names
-    key = (mesh, axes, block_iters, max_blocks)
-    core = _CORE_CACHE.get(key)
-    if core is None:
-        core = _sharded_core(mesh, axes, block_iters, max_blocks)
-        _CORE_CACHE[key] = core
-
-    # upload the raw row shards once; all scaling happens on device
-    G_dev = jax.device_put(G, NamedSharding(mesh, P(axes, None)))
-    x, lam, mu, res = core(
-        G_dev,
-        jnp.asarray(c, jnp.float32),
-        jnp.asarray(a_row, jnp.float32),
-        jnp.asarray(b, jnp.float32),
-        jnp.asarray([tol], jnp.float32),
+    x, lam, mu, res = _run_core(
+        mesh, G, np.zeros(rows, dtype=np.float32), c, a_row, b, tol,
+        block_iters, max_blocks,
     )
     x = np.asarray(x, dtype=np.float64)
     res_f = float(np.asarray(res)[0])
@@ -227,3 +252,67 @@ def solve_dual_lp_pdhg_sharded(
     yhat = float(x[n])
     objective = float(c @ x)
     return DualSolution(ok=bool(res_f <= tol * 4.0), y=y, yhat=yhat, objective=objective)
+
+
+def solve_decomp_master_sharded(
+    MT: np.ndarray,
+    v: np.ndarray,
+    mesh: Mesh,
+    cfg: Optional[Config] = None,
+    tol: Optional[float] = None,
+    max_blocks: int = 120,
+    block_iters: int = 512,
+):
+    """The face-decomposition two-sided ε-LP with mesh-sharded rows.
+
+    Same LP as ``cg_typespace._decomp_lp`` / ``face_decompose._master_pdhg``:
+    variables ``[p (C), ε]``, ``min ε`` s.t. ``v − ε ≤ M p ≤ v + ε``,
+    ``Σp = 1``, all ≥ 0 — the flagship solve path's heaviest recurring
+    kernel, here row-sharded over the mesh (2T rows split across devices,
+    psum-reduced transposed GEMVs) so pools whose type count outgrows one
+    chip keep scaling. Returns ``(eps_realized, w, p_norm, eps_obj, ok)``
+    with the same semantics as ``_master_pdhg`` (the arithmetic
+    ``eps_realized`` is solver-independent; ``w = y_lo − y_up`` are the
+    aiming duals).
+    """
+    cfg = cfg or default_config()
+    tol = float(cfg.pdhg_tol if tol is None else tol)
+    MT = np.asarray(MT, dtype=np.float64)
+    T, C = MT.shape
+    ndev = mesh.devices.size
+    v = np.asarray(v, dtype=np.float64)
+
+    # pad columns to a bucket so successive face rounds (whose column
+    # counts differ) reuse one compiled program: a zero column has zero
+    # cost/constraint coefficients, keeps Ruiz scale 1, and its variable
+    # stays at its zero start
+    bucket = 2048
+    Cp = -(-(C + 1) // bucket) * bucket
+    rows = -(-(2 * T) // ndev) * ndev
+    G = np.zeros((rows, Cp), dtype=np.float32)
+    G[:T, :C] = -MT
+    G[T : 2 * T, :C] = MT
+    G[: 2 * T, C] = -1.0
+    h = np.zeros(rows, dtype=np.float32)
+    h[:T] = -v
+    h[T : 2 * T] = v
+    a_row = np.zeros(Cp)
+    a_row[:C] = 1.0
+    b = np.array([1.0])
+    c = np.zeros(Cp)
+    c[C] = 1.0
+
+    x, lam, mu, res = _run_core(
+        mesh, G, h, c, a_row, b, tol, block_iters, max_blocks
+    )
+    x = np.asarray(x, dtype=np.float64)
+    lam = np.asarray(lam, dtype=np.float64)
+    res_f = float(np.asarray(res)[0])
+    p = np.maximum(x[:C], 0.0)
+    total = p.sum()
+    if not np.isfinite(total) or total <= 0.0:
+        return float("inf"), np.zeros(T), np.full(C, 1.0 / max(C, 1)), float("inf"), False
+    p_norm = p / total
+    eps_real = float(np.abs(MT @ p_norm - v).max())
+    w = np.maximum(lam[:T], 0.0) - np.maximum(lam[T : 2 * T], 0.0)
+    return eps_real, w, p_norm, float(x[C]), bool(res_f <= tol * 4.0)

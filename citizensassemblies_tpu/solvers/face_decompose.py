@@ -364,15 +364,36 @@ def realize_profile(
         C = np.stack(cols, axis=0)
         MT = np.ascontiguousarray((C.astype(np.float64) / m[None, :]).T)
         if use_pdhg:
-            # adaptive budget: far from acceptance the duals only need to be
-            # roughly right to aim the expansion; near it the iterate itself
-            # must realize v, so spend the iterations where they matter
-            far = not eps_hist or eps_hist[-1] > 6 * accept
-            eps, w, p, eps_obj, pdhg_warm, _ok = _master_pdhg(
-                MT, v, cfg, pdhg_warm,
-                max_iters=4_096 if far else 12_288, tol=master_tol,
-            )
-            lp_solves += 1
+            import jax
+
+            if (
+                jax.device_count() > 1
+                and MT.shape[0] >= cfg.master_shard_min_types
+            ):
+                # beyond-one-chip master: rows sharded over the mesh,
+                # psum-reduced transposes (no warm start — the sharded
+                # regime trades it for memory scale-out)
+                from citizensassemblies_tpu.parallel.mesh import default_mesh
+                from citizensassemblies_tpu.parallel.solver import (
+                    solve_decomp_master_sharded,
+                )
+
+                eps, w, p, eps_obj, _ok = solve_decomp_master_sharded(
+                    MT, v, default_mesh(), cfg=cfg, tol=master_tol
+                )
+                pdhg_warm = None
+                lp_solves += 1
+            else:
+                # adaptive budget: far from acceptance the duals only need
+                # to be roughly right to aim the expansion; near it the
+                # iterate itself must realize v, so spend the iterations
+                # where they matter
+                far = not eps_hist or eps_hist[-1] > 6 * accept
+                eps, w, p, eps_obj, pdhg_warm, _ok = _master_pdhg(
+                    MT, v, cfg, pdhg_warm,
+                    max_iters=4_096 if far else 12_288, tol=master_tol,
+                )
+                lp_solves += 1
             # end-game: the approximate objective says the support should be
             # able to realize v, but the first-order iterate's own residual
             # still lags — extract the exact optimum once on the support

@@ -143,6 +143,14 @@ class Config:
     #: GEMVs want the mesh (SURVEY §5 long-context analog). Below it the
     #: host/single-device solvers win on latency.
     dual_shard_min_rows: int = 4_096
+    #: route the face-decomposition master through the mesh-sharded PDHG
+    #: (``parallel/solver.py::solve_decomp_master_sharded``) when more than
+    #: one device is visible and the problem has at least this many distinct
+    #: agent TYPES — the sharded axis is the 2T constraint rows, and the
+    #: master's column count is architecturally capped (~6k) while the type
+    #: count grows with pool diversity; beyond one chip's comfortable row
+    #: set the mesh carries it.
+    master_shard_min_types: int = 4_096
 
     # --- backends -------------------------------------------------------------
     #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),
