@@ -25,7 +25,7 @@ after certification).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import jax
 import numpy as np

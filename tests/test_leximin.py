@@ -16,7 +16,6 @@ from citizensassemblies_tpu.core.instance import (
 )
 from citizensassemblies_tpu.models.leximin import find_distribution_leximin
 from citizensassemblies_tpu.ops.stats import prob_allocation_stats
-from citizensassemblies_tpu.utils.config import Config
 
 
 def brute_force_leximin(A, qmin, qmax, k):

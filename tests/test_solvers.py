@@ -160,8 +160,6 @@ def test_pdhg_loosened_acceptance_boundary():
     (the flag mirrors the residual exactly) and *safe* (an accepted solve is
     still close to the exact optimum; a rejected one routes callers to the
     HiGHS fallback). VERDICT r1 weak #8."""
-    import dataclasses as _dc
-
     from citizensassemblies_tpu.solvers.highs_backend import solve_dual_lp
     from citizensassemblies_tpu.utils.config import default_config
 
