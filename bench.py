@@ -221,12 +221,20 @@ def main() -> None:
             ("nexus_skewed_170", nexus_skewed_instance, 83.4),
         ):
             d2, s2 = featurize(builder())
-            t0 = time.time()
-            r2 = find_distribution_leximin(d2, s2)
-            el2 = time.time() - t0
+            # median of 3: these rows are seconds each, and a single-sample
+            # row is one TPU-tunnel latency burst away from recording a 20×
+            # outlier as the instance's number
+            times2 = []
+            for _ in range(int(os.environ.get("BENCH_REPS", "3"))):
+                t0 = time.time()
+                r2 = find_distribution_leximin(d2, s2)
+                times2.append(time.time() - t0)
+            times2.sort()
+            el2 = times2[len(times2) // 2]
             st2 = prob_allocation_stats(r2.allocation, cap_for_geometric_mean=False)
             detail[name] = {
                 "seconds": round(el2, 1),
+                "runs_s": [round(t, 1) for t in times2],
                 "baseline_s": base,
                 "baseline_estimated": True,
                 "speedup": round(base / max(el2, 1e-9), 1),
