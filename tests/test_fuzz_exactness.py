@@ -77,3 +77,24 @@ def test_fuzz_leximin_certified_invariants(n, k, ncat, fpc, seed, skew):
     assert (
         audit["certified_maximin_upper"] >= audit["achieved_min"] - 1e-9
     ), audit
+
+
+@pytest.mark.parametrize("n,k,ncat,fpc,seed,skew", CASES[:3])
+def test_fuzz_xmin_band_and_spread(n, k, ncat, fpc, seed, skew):
+    """XMIN on heterogeneous instances: per-agent probabilities stay within
+    the configured L∞ band of their leximin values while the support grows
+    (the banded spread blend must hold its contract on arbitrary shapes)."""
+    from citizensassemblies_tpu.models.xmin import find_distribution_xmin
+    from citizensassemblies_tpu.utils.config import default_config
+
+    inst = skewed_instance(
+        n=n, k=k, n_categories=ncat, features_per_category=fpc,
+        seed=seed, skew=skew,
+    )
+    dense, space = featurize(inst)
+    cfg = default_config()
+    lex = find_distribution_leximin(dense, space, cfg=cfg)
+    xm = find_distribution_xmin(dense, space, cfg=cfg, leximin=lex)
+    dev = float(np.abs(xm.allocation - xm.fixed_probabilities).max())
+    assert dev <= max(cfg.xmin_linf_band, 1e-3) + 1e-9, dev
+    assert len(xm.support()) >= len(lex.support())
