@@ -211,8 +211,10 @@ def main() -> None:
 
         from citizensassemblies_tpu.core.generator import (
             cca_skewed_instance,
+            hd_skewed_instance,
             nexus_skewed_instance,
             obf_skewed_instance,
+            sf_d_skewed_instance,
             sf_e_skewed_instance,
         )
 
@@ -225,6 +227,8 @@ def main() -> None:
             ("cca_skewed_75", cca_skewed_instance, 433.5),
             ("obf_skewed_30", obf_skewed_instance, 183.9),
             ("nexus_skewed_170", nexus_skewed_instance, 83.4),
+            ("hd_skewed_30", hd_skewed_instance, 37.2),
+            ("sf_d_skewed_40", sf_d_skewed_instance, 46.2),
         ):
             d2, s2 = featurize(builder())
             # median of 3: these rows are seconds each, and a single-sample

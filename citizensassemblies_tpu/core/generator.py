@@ -239,6 +239,38 @@ def obf_skewed_instance(seed: int = 1) -> Instance:
     )
 
 
+def hd_skewed_instance(seed: int = 2) -> Instance:
+    """Heterogeneous synthetic stand-in shaped like ``hd_30`` (n=239, k=30,
+    7 categories, LEXIMIN Gini 52.9 % / min 5.1 % / runtime 37.2 s,
+    ``reference_output/hd_30_statistics.txt:2-5,9,15``). Skew 0.8 with the
+    default seed lands in the real band — measured Gini 0.535 / min 2.5 %."""
+    return skewed_instance(
+        n=239,
+        k=30,
+        n_categories=7,
+        features_per_category=[2, 3, 2, 4, 3, 2, 3],
+        seed=seed,
+        skew=0.8,
+        name="hd_skewed_30",
+    )
+
+
+def sf_d_skewed_instance(seed: int = 1) -> Instance:
+    """Heterogeneous synthetic stand-in shaped like ``sf_d_40`` (n=404, k=40,
+    6 categories, LEXIMIN Gini 48.7 % / min 4.7 % / runtime 46.2 s,
+    ``reference_output/sf_d_40_statistics.txt:2-5,9,15``). Skew 0.8 with the
+    default seed lands near the real band — measured Gini 0.419 / min 3.8 %."""
+    return skewed_instance(
+        n=404,
+        k=40,
+        n_categories=6,
+        features_per_category=[2, 3, 4, 2, 3, 3],
+        seed=seed,
+        skew=0.8,
+        name="sf_d_skewed_40",
+    )
+
+
 def nexus_skewed_instance(seed: int = 1) -> Instance:
     """Heterogeneous synthetic stand-in shaped like ``nexus_170`` — the
     high-selection-ratio reference instance (n=342, k=170: half the pool is
