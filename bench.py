@@ -218,10 +218,11 @@ def main() -> None:
             sf_e_skewed_instance,
         )
 
-        # regime sweep (VERDICT r2 item #6): the hardest remaining baseline
-        # shapes — cca_75 (n=825, 4 cats, strongly heterogeneous), obf_30
-        # (n=321, 8 cats) and nexus_170 (n=342, k=170: the high-selection-
-        # ratio regime). Real pools withheld; baselines are the reference
+        # regime sweep (VERDICT r2 item #6): the remaining baseline shapes —
+        # cca_75 (n=825, 4 cats, strongly heterogeneous), obf_30 (n=321,
+        # 8 cats), nexus_170 (n=342, k=170: the high-selection-ratio
+        # regime), and the mid-tier hd_30 (n=239, 7 cats) and sf_d_40
+        # (n=404, 6 cats). Real pools withheld; baselines are the reference
         # timings on the real instances, marked estimated.
         for name, builder, base in (
             ("cca_skewed_75", cca_skewed_instance, 433.5),
