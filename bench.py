@@ -202,6 +202,16 @@ def main() -> None:
                             median_timers.items(), key=lambda kv: -kv[1]
                         )
                     },
+                    # per-rep phase splits, sorted by wall-clock to align
+                    # with runs_s (VERDICT r3 #3: a tail rep must be
+                    # attributable to its binding phase, not summarized away
+                    # by the median's split)
+                    "phase_times_per_rep": [
+                        {k: round(v, 1) for k, v in sorted(
+                            timers.items(), key=lambda kv: -kv[1]
+                        )}
+                        for _, timers in runs
+                    ],
                 }
                 if audit is not None:
                     detail[key]["exactness_audit"] = audit
@@ -234,14 +244,17 @@ def main() -> None:
             d2, s2 = featurize(builder())
             # median of 3: these rows are seconds each, and a single-sample
             # row is one TPU-tunnel latency burst away from recording a 20×
-            # outlier as the instance's number
-            times2 = []
+            # outlier as the instance's number. Keep (time, result) pairs so
+            # the quality stats describe the SAME solve as the reported
+            # median time, as the flagship rows do.
+            runs2 = []
             for _ in range(int(os.environ.get("BENCH_REPS", "3"))):
                 t0 = time.time()
                 r2 = find_distribution_leximin(d2, s2)
-                times2.append(time.time() - t0)
-            times2.sort()
-            el2 = times2[len(times2) // 2]
+                runs2.append((time.time() - t0, r2))
+            runs2.sort(key=lambda tr: tr[0])
+            times2 = [t for t, _ in runs2]
+            el2, r2 = runs2[len(runs2) // 2]
             st2 = prob_allocation_stats(r2.allocation, cap_for_geometric_mean=False)
             detail[name] = {
                 "seconds": round(el2, 1),

@@ -74,13 +74,13 @@ _KEY_FIELDS = {
     "legacy": ("mc_iterations", "mc_batch", "mc_max_resample_rounds"),
     "leximin": (
         "eps", "fixed_prob_relax_step", "support_eps", "mw_rounds_factor",
-        "mw_decay", "mw_smooth", "pricing_batch", "seed_batch",
+        "pricing_batch", "seed_batch",
         "cg_columns_per_round", "max_portfolio", "pdhg_max_iters", "pdhg_tol",
         "backend", "solver_seed",
     ),
 }
 _KEY_FIELDS["xmin"] = _KEY_FIELDS["leximin"] + (
-    "xmin_iterations_factor", "xmin_dedup_attempts_factor",
+    "xmin_iterations_factor", "xmin_dedup_attempts_factor", "xmin_qp_iters",
 )
 
 

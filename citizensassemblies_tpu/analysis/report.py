@@ -210,8 +210,10 @@ def analyze_instance(
             )
             mses = intersection_mses(shares)
             log.log(_RULE)
+            # golden layout has no colon on these lines
+            # (reference_output/sf_e_110_statistics.txt:15-21)
             for (s1, s2), mse in mses.items():
-                log.log(f"MSE({s1}, {s2}):", f"{mse:.2e}")
+                log.log(f"MSE({s1}, {s2})", f"{mse:.2e}")
             plots.plot_intersectional_representation(shares, out_dir, stem)
 
         # --- timing harness (analysis.py:625-634) -----------------------------

@@ -328,6 +328,43 @@ def sf_e_like_instance(seed: int = 0) -> Instance:
     )
 
 
+def sf_e_schema_instance(seed: int = 1, n: int = 1727, k: int = 110) -> Instance:
+    """sf_e_110-shaped synthetic pool carrying the REAL anonymized schema of
+    the one sf_e artifact the reference ships,
+    ``data/sf_e_110/intersections.csv`` (346 rows; the pool itself is withheld
+    for privacy, ``README.md:125-132``): 7 categories named ``a``–``g`` with
+    feature counts (3, 4, 12, 3, 5, 2, 2) and features named ``a1``…``g2``, so
+    the shipped intersections file parses against this pool's feature space
+    verbatim and the C21 pipeline (``ops/intersections.py``) can be exercised
+    on real data end-to-end. ``n``/``k`` default to the real shape; smaller
+    values keep the schema (every feature still appears in the pool) for
+    CPU-sized tests.
+    """
+    import dataclasses
+
+    base = skewed_instance(
+        n=n,
+        k=k,
+        n_categories=7,
+        features_per_category=[3, 4, 12, 3, 5, 2, 2],
+        seed=seed,
+        skew=0.4,
+        name="sf_e_110",
+    )
+    cat_names = ["a", "b", "c", "d", "e", "f", "g"]
+    renames: Dict[str, Tuple[str, Dict[str, str]]] = {}
+    categories: Dict[str, Dict[str, Quota]] = {}
+    for (old_cat, feats), new_cat in zip(base.categories.items(), cat_names):
+        fmap = {old: f"{new_cat}{i + 1}" for i, old in enumerate(feats)}
+        renames[old_cat] = (new_cat, fmap)
+        categories[new_cat] = {fmap[old]: q for old, q in feats.items()}
+    agents = [
+        {renames[c][0]: renames[c][1][f] for c, f in agent.items()}
+        for agent in base.agents
+    ]
+    return dataclasses.replace(base, categories=categories, agents=agents)
+
+
 def example_small_like_instance(seed: int = 0) -> Instance:
     """Synthetic stand-in shaped like ``example_small_20``: n=200, k=20, two
     binary categories with quotas [9, 20] (see

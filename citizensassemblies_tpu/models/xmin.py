@@ -69,12 +69,12 @@ def find_distribution_xmin(
     #    iterations appends one panel not yet in the portfolio, retrying up
     #    to 3n samples for it, ``xmin.py:464-474``) — so collect until 5n
     #    new panels or the matching total-draw effort bound is spent.
-    target_new = cfg.xmin_iterations_factor * n
+    target_new = max(1, int(round(cfg.xmin_iterations_factor * n)))
     # total-draw effort bound: dedup_attempts_factor·n tries per distinct
     # addition (the reference's 3n, ``xmin.py:466``) × target_new additions
     # (cfg.xmin_iterations_factor·n distinct panels — see config.py for why
     # that exceeds the reference's literal 5n iteration count)
-    max_draws = cfg.xmin_dedup_attempts_factor * n * target_new
+    max_draws = int(cfg.xmin_dedup_attempts_factor * n * target_new)
     seen = {tuple(np.nonzero(row)[0].tolist()) for row in leximin.committees}
     new_rows: List[np.ndarray] = []
     key = jax.random.PRNGKey(cfg.solver_seed + 1)
@@ -105,7 +105,9 @@ def find_distribution_xmin(
     )
 
     # 3) min-L2 redistribution over the grown portfolio (xmin.py:447-455)
-    probs, eps_dev = solve_final_primal_l2(P, leximin.fixed_probabilities)
+    probs, eps_dev = solve_final_primal_l2(
+        P, leximin.fixed_probabilities, iters=cfg.xmin_qp_iters
+    )
     probs = np.clip(probs, 0.0, 1.0)
     probs = probs / probs.sum()
     allocation = P.T.astype(np.float64) @ probs

@@ -219,27 +219,42 @@ def _marginal_probe_confirm(
     # the stage LP's unfixed floors are EXACT (x_u ≥ z·m_u rows, no slack),
     # so its optimum provably lies on the face with floors z − probe_relax
     # for any probe_relax > 0 — only solver feasibility tolerance needs
-    # covering, not the fixing margin. A loose face (the old margin+slack
-    # relaxation) freed (margin+slack)·Σm ≈ 1e-4-scale reroutable mass,
-    # which made every sound group-probe budget negative and degraded
-    # tranche certification to one LP per candidate.
-    probe_relax = max(1e-8, floor_slack)
-    lo = np.where(
-        unfixed,
-        np.maximum(z - probe_relax, 0.0) * m,
-        (np.maximum(fixed, 0.0) - floor_slack) * m,
-    )
-    lo = np.clip(lo, 0.0, m)
-    bounds = [(lo[t], m[t]) for t in range(T)]
+    # covering, not the fixing margin; the floor is HiGHS's ~1e-7 primal
+    # feasibility tolerance (anything lower and the stage optimum can
+    # violate the face floors by more than the relaxation, rendering the
+    # face numerically empty and burning slack-ladder escalations). A loose
+    # face (the old margin+slack relaxation) freed (margin+slack)·Σm ≈
+    # 1e-4-scale reroutable mass, which made every sound group-probe budget
+    # negative and degraded tranche certification to one LP per candidate.
+    probe_relax = max(1e-7, floor_slack)
     A_eq = np.ones((1, T))
 
-    def face_max(w: np.ndarray):
-        r = robust_linprog(
-            -w, A_ub=quota_A, b_ub=quota_b, A_eq=A_eq, b_eq=[k], bounds=bounds
+    def _bounds_at(relax: float):
+        lo = np.where(
+            unfixed,
+            np.maximum(z - relax, 0.0) * m,
+            (np.maximum(fixed, 0.0) - floor_slack) * m,
         )
-        if r.status == 0:
-            return float(-r.fun)
-        return -np.inf if r.status == 2 else None  # infeasible vs failed
+        lo = np.clip(lo, 0.0, m)
+        return [(lo[t], m[t]) for t in range(T)]
+
+    bounds = _bounds_at(probe_relax)
+    bounds_relaxed = _bounds_at(10.0 * probe_relax)
+
+    def _face_max_over(bnds):
+        def fm(w: np.ndarray):
+            r = robust_linprog(
+                -w, A_ub=quota_A, b_ub=quota_b, A_eq=A_eq, b_eq=[k], bounds=bnds
+            )
+            if r.status == 0:
+                return float(-r.fun)
+            return -np.inf if r.status == 2 else None  # infeasible vs failed
+        return fm
+
+    face_max = _face_max_over(bounds)
+    # retry probe for objective-specific infeasible reports: same face with
+    # floors 10× looser — a superset, so its optimum is a valid upper bound
+    face_max_relaxed = _face_max_over(bounds_relaxed)
 
     cand = np.asarray(cand)
     if z >= 1.0 - probe_tol:
@@ -269,6 +284,7 @@ def _marginal_probe_confirm(
         slack_gain / m[cand],
         term_deficit=probe_relax,
         log=log.emit if log is not None else None,
+        face_max_relaxed=face_max_relaxed,
     )
 
 

@@ -208,13 +208,20 @@ def leximin_over_compositions(
         A_eq_p = np.ones((1, C))
         bounds_p = [(0, None)] * C
 
-        def face_max(obj_rows: np.ndarray) -> Optional[float]:
-            nonlocal lp_solves
-            r = _linprog(-obj_rows, A_p, b_p, A_eq_p, [1.0], bounds_p)
-            lp_solves += 1
-            if r.status == 0:
-                return float(-r.fun)
-            return -np.inf if r.status == 2 else None  # infeasible vs failed
+        def _face_max_over(rhs):
+            def fm(obj_rows: np.ndarray) -> Optional[float]:
+                nonlocal lp_solves
+                r = _linprog(-obj_rows, A_p, rhs, A_eq_p, [1.0], bounds_p)
+                lp_solves += 1
+                if r.status == 0:
+                    return float(-r.fun)
+                return -np.inf if r.status == 2 else None  # infeasible vs failed
+            return fm
+
+        face_max = _face_max_over(b_p)
+        # retry probe for objective-specific infeasible reports: floors 10×
+        # looser — a superset face, so its optimum is a valid upper bound
+        face_max_relaxed = _face_max_over(b_p + 9.0 * _SLACK)
 
         # tranche candidates from the duals, probe-certified via the shared
         # group-then-individual scheme (lp_util.probe_confirm_tranche). The
@@ -231,6 +238,7 @@ def leximin_over_compositions(
                 face_max, MT[unfixed[cand]], z, probe_tol,
                 slack_gain / msz[unfixed[cand]],
                 term_deficit=_SLACK, log=log.emit,
+                face_max_relaxed=face_max_relaxed,
             )
             tranche[cand[conf]] = True
         # near-zero dual weight can still be degenerately tight everywhere —
@@ -242,6 +250,7 @@ def leximin_over_compositions(
                 face_max, MT[unfixed[j]][None, :], z, probe_tol,
                 np.array([slack_gain / float(msz[unfixed[j]])]),
                 term_deficit=_SLACK, log=log.emit,
+                face_max_relaxed=face_max_relaxed,
             )[0]:
                 tranche[j] = True
         if not tranche.any():

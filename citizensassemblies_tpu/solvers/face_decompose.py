@@ -352,7 +352,8 @@ def realize_profile(
         # a first-order iterate wobbles ±30 %, and comparing raw values made
         # noisy upticks read as a stall while the hull was still improving
         if len(eps_hist) >= 7 and min(eps_hist[-4:]) > min(eps_hist[:-4]) * 0.98:
-            # <2 % progress over 6 rounds: an integrality residual the face
+            # the best of the last 4 rounds failed to beat the running best
+            # of all earlier rounds by ≥2 %: an integrality residual the face
             # cannot close (e.g. a fractionally-coverable type no integer
             # composition contains) — stop burning rounds; the stage-CG
             # fallback recomputes every value over realizable columns only,

@@ -68,6 +68,7 @@ def probe_confirm_tranche(
     allowances: np.ndarray,
     term_deficit: float = 0.0,
     log: Optional[Callable[[str], object]] = None,
+    face_max_relaxed: Optional[Callable[[np.ndarray], Optional[float]]] = None,
 ) -> np.ndarray:
     """Certify which leximin tranche candidates are capped at ``z`` over a
     stage's optimal face.
@@ -95,9 +96,15 @@ def probe_confirm_tranche(
     feasible LPs infeasible): it falls through to the per-candidate probes.
     A per-candidate infeasible face certifies only after the face itself is
     confirmed non-empty (one zero-objective feasibility solve, cached per
-    tranche): on a non-empty face, status-2 for a bounded objective is a
-    solver mis-report best read as "nothing exceeds z materially", and the
-    event is logged. If the face is genuinely empty — the reported ``z``
+    tranche) AND, when the caller supplies ``face_max_relaxed`` (the same
+    maximization over a slightly enlarged face — a superset, so its optimum
+    upper-bounds the face optimum), a retry on that enlarged face also fails
+    to produce a finite value. A finite retry value is decisive either way:
+    within budget it is a genuine certificate; above budget it is genuine
+    headroom and nothing is certified — so an objective-specific numerical
+    failure can no longer fix a loose candidate. Only when the retry is also
+    infeasible/failed is status-2 on a non-empty face read as a solver
+    mis-report ("nothing exceeds z materially"), and the event is logged. If the face is genuinely empty — the reported ``z``
     overstates the true stage optimum by more than the face relaxation —
     nothing is certified: an empty face carries no tightness information,
     and falsely confirming would fix loose candidates at an understated
@@ -131,6 +138,16 @@ def probe_confirm_tranche(
                     )
             if face_state["empty"]:
                 return
+            if face_max_relaxed is not None:
+                rv = face_max_relaxed(objectives[i])
+                if rv is not None and rv != -np.inf:
+                    # superset optimum ≥ face optimum: within budget it
+                    # certifies, above budget it is genuine headroom —
+                    # either way the infeasible report was objective-specific
+                    # and must not certify on its own
+                    if rv <= z + probe_tol + float(allowances[i]):
+                        confirmed[i] = True
+                    return
             confirmed[i] = True
             infeasible_fixes += 1
         elif got is not None and got <= z + probe_tol + float(allowances[i]):

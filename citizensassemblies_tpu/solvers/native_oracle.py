@@ -227,6 +227,16 @@ def repair_slice_native(
     lib = _load_repair()
     if lib is None:
         return None
+    # c/counts are mutated in place through raw pointers: anything but
+    # contiguous int32 (e.g. the int64 arrays natural elsewhere in
+    # _slice_relaxation) would be reinterpreted, silently corrupting the
+    # slice — reject rather than guess at a copy-back contract
+    for name, arr in (("c", c), ("counts", counts)):
+        if arr.dtype != np.int32 or not arr.flags.c_contiguous:
+            raise ValueError(
+                f"repair_slice_native: {name} must be contiguous int32 "
+                f"(got {arr.dtype}, contiguous={arr.flags.c_contiguous})"
+            )
     # TypeReduction stores these contiguous int32 already, so the casts are
     # zero-copy views — no per-slice conversion cost
     tf = np.ascontiguousarray(reduction.type_feature, dtype=np.int32)

@@ -42,12 +42,10 @@ class Config:
     #: path replaces them with batched stochastic sampling, this knob scales
     #: the batch budget instead).
     mw_rounds_factor: int = 3
-    #: weight decay applied to members of a freshly discovered committee
-    #: (reference ``leximin.py:259``).
-    mw_decay: float = 0.8
-    #: smoothing applied when a duplicate committee is produced
-    #: (reference ``leximin.py:273``): w <- mw_smooth * w + (1 - mw_smooth).
-    mw_smooth: float = 0.9
+    # NOTE: the reference's MW decay (0.8, ``leximin.py:259``) and duplicate
+    # smoothing (0.9/0.1, ``leximin.py:273``) have no analog here — the
+    # batched-draw seeding replaced the sequential MW loop entirely, so those
+    # two knobs are intentionally absent rather than carried as dead config.
     #: panels sampled per stochastic pricing batch on device.
     pricing_batch: int = 4_096
     #: cap on the batched portfolio-seeding draw (keeps the first dual LPs
@@ -116,7 +114,14 @@ class Config:
     #: (``xmin.py:511``) but its per-iteration CG re-solves add further
     #: pricing columns, so its final support exceeds 5n + seed; 8n distinct
     #: batched draws reaches the same support without the O(n) re-solves.
-    xmin_iterations_factor: int = 8
+    #: May be fractional (e.g. 0.25 on a large pool) when a capped expansion
+    #: is wanted — CI on CPU, quick-look runs.
+    xmin_iterations_factor: float = 8
+    #: dual-ascent iterations for the min-L2 final stage
+    #: (``solvers/qp.py::solve_final_primal_l2``). 20k converges the spread
+    #: on every benched instance; the knob exists because the fixed-count
+    #: loop is the CPU-test bottleneck at large portfolios.
+    xmin_qp_iters: int = 20_000
     #: attempts to sample a panel not already in the portfolio, as a multiple
     #: of n (reference ``xmin.py:466``).
     xmin_dedup_attempts_factor: int = 3

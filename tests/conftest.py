@@ -42,3 +42,10 @@ def example_small(reference_data_dir):
     from citizensassemblies_tpu.core.instance import read_instance_dir
 
     return read_instance_dir(reference_data_dir / "example_small_20")
+
+
+@pytest.fixture(scope="session")
+def example_large(reference_data_dir):
+    from citizensassemblies_tpu.core.instance import read_instance_dir
+
+    return read_instance_dir(reference_data_dir / "example_large_200")

@@ -310,3 +310,105 @@ def test_golden_statistics_numeric_diff(example_small, tmp_path):
         got_m = re.search(re.escape(label) + r"\s*(\d+)", ours)
         want_m = re.search(re.escape(label) + r"\s*(\d+)", golden)
         assert got_m and want_m and got_m.group(1) == want_m.group(1), label
+
+
+def test_golden_statistics_example_large_real_data(example_large, tmp_path):
+    """Solve the REAL ``data/example_large_200`` CSVs (n=2000, k=200) end to
+    end — LEGACY×2 + LEXIMIN + XMIN through ``analyze_instance`` — and assert
+    the numeric lines against the golden
+    ``reference_output/example_large_200_statistics.txt`` (VERDICT r3 #1).
+
+    The exact LEXIMIN lines (min 10.0%, gini 0.0%, gmean 10.0%) are tight:
+    the type-space enumeration solves this instance in ~0.3 s. The 10k-draw
+    Monte-Carlo is what the reference spends its time on, so draws are capped
+    at 500 here and the MC tolerances widened by the sampling-noise scale
+    ``sqrt(10000/500)`` — LEGACY's gini on this near-symmetric instance is
+    noise-dominated (golden 1.8% at 10k draws ≈ σ/(μ√π)), so it scales with
+    that factor rather than staying put."""
+    import math
+    import re
+
+    from citizensassemblies_tpu.analysis.report import analyze_instance
+
+    golden_path = Path(
+        "/root/reference/reference_output/example_large_200_statistics.txt"
+    )
+    if not golden_path.exists():
+        pytest.skip("golden statistics not mounted")
+
+    draws = 500
+    noise_scale = math.sqrt(10_000 / draws)
+    cfg = default_config().replace(
+        mc_iterations=draws,
+        mc_batch=512,
+        pricing_batch=512,
+        # capped expansion + ascent budget: the full 8n-panel XMIN portfolio
+        # and 20k-iteration QP are TPU-sized, not CPU-CI-sized
+        xmin_iterations_factor=0.25,
+        xmin_qp_iters=3_000,
+    )
+    result = analyze_instance(
+        example_large,
+        out_dir=tmp_path / "analysis",
+        cache_dir=tmp_path / "distributions",
+        skip_timing=True,
+        cfg=cfg,
+        echo=False,
+    )
+    ours = (tmp_path / "analysis" / "example_large_200_statistics.txt").read_text(
+        encoding="utf-8"
+    )
+    golden = golden_path.read_text(encoding="utf-8")
+
+    def field(text: str, label: str) -> float:
+        m = re.search(re.escape(label) + r"[^\d≤]*≤?\s*([\d.]+)%", text)
+        assert m, f"statistics line not found: {label!r}"
+        return float(m.group(1))
+
+    # exact lines: the enumeration path must reproduce Gurobi's leximin
+    for label in (
+        "mean selection probability k/n:",
+        "LEXIMIN minimum probability (exact):",
+        "gini coefficient of LEXIMIN:",
+        "geometric mean of LEXIMIN:",
+    ):
+        got, want = field(ours, label), field(golden, label)
+        assert abs(got - want) <= 0.1, f"{label} {got}% vs golden {want}%"
+
+    # XMIN preserves the leximin profile within the L∞ band (fork capability;
+    # the upstream golden file predates XMIN so it has no line to diff)
+    assert abs(field(ours, "XMIN minimum probability (exact):") - 10.0) <= 0.15
+
+    # MC lines, tolerances widened by the draw-count noise scale
+    got = field(ours, "gini coefficient of LEGACY:")
+    want = field(golden, "gini coefficient of LEGACY:")
+    assert got <= want * noise_scale * 2.0 + 0.5, (
+        f"LEGACY gini {got}% vs noise-scaled golden {want * noise_scale:.1f}%"
+    )
+    got = field(ours, "geometric mean of LEGACY:")
+    want = field(golden, "geometric mean of LEGACY:")
+    assert abs(got - want) <= 1.0, f"LEGACY gmean {got}% vs golden {want}%"
+    # golden UCB ≤ 0.25% at 10k draws; the bound loosens roughly ∝ 1/draws
+    assert field(ours, "LEGACY minimum probability:") <= 2.0
+    # knife-edge statistic centred at ~50% (leximin min == mean here)
+    got = field(
+        ours,
+        "share selected by LEGACY with probability below LEXIMIN minimum "
+        "selection probability:",
+    )
+    want = field(
+        golden,
+        "share selected by LEGACY with probability below LEXIMIN minimum "
+        "selection probability:",
+    )
+    assert abs(got - want) <= 20.0
+
+    for label in ("pool size n:", "panel size k:", "# quota categories:"):
+        got_m = re.search(re.escape(label) + r"\s*(\d+)", ours)
+        want_m = re.search(re.escape(label) + r"\s*(\d+)", golden)
+        assert got_m and want_m and got_m.group(1) == want_m.group(1), label
+
+    # every agent is covered at exactly k/n — the allocation itself, not just
+    # its summary lines, matches the golden claim
+    lex = result.runs["leximin"].allocation
+    assert float(np.abs(lex - 0.1).max()) <= 1e-3
