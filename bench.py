@@ -302,46 +302,78 @@ def main() -> None:
             "min_prob": round(float(xm.allocation.min()), 6),
         }
 
-        # household-constrained mid-size run (VERDICT r2 item #5): ~2-person
-        # households force the agent-space CG — the path the reference always
-        # takes — at sf_d scale (n=400).
+        # household-constrained runs (VERDICT r2 #5 / r3 #5). The reference
+        # handles households by staying in agent space forever
+        # (leximin.py:211-221); here they route through the household
+        # QUOTIENT (solvers/quotient.py): orbits = (household class, base
+        # type), class caps as quota rows, household-disjoint slicing. The
+        # n=400 row shows the before/after against r3's agent-space 32.9 s;
+        # the n=1200 row is the at-scale evidence, with a solver-independent
+        # audit_maximin certificate evaluated on the augmented instance
+        # (class caps built in ⇒ the MILP bound is tight for the
+        # household-constrained feasible set, not just an over-set).
         from citizensassemblies_tpu.core.generator import skewed_instance
+        from citizensassemblies_tpu.solvers.highs_backend import audit_maximin
+        from citizensassemblies_tpu.solvers.quotient import build_household_quotient
 
-        hh_inst = skewed_instance(
-            n=400, k=40, n_categories=6, seed=2,
-            features_per_category=[2, 3, 4, 2, 3, 3],
-        )
-        hh_dense, hh_space = featurize(hh_inst)
-        households = np.arange(400) // 2  # 200 two-person households
-        t0 = time.time()
-        try:
-            hh = find_distribution_leximin(hh_dense, hh_space, households=households)
-        except Exception as exc:  # InfeasibleQuotasError: apply the suggestion
-            from citizensassemblies_tpu.core.instance import InfeasibleQuotasError
+        def _run_households(tag, inst_h, households):
+            hh_dense, hh_space = featurize(inst_h)
+            t0 = time.time()
+            try:
+                hh = find_distribution_leximin(
+                    hh_dense, hh_space, households=households
+                )
+            except Exception as exc:  # InfeasibleQuotasError: apply suggestion
+                from citizensassemblies_tpu.core.instance import (
+                    InfeasibleQuotasError,
+                )
 
-            if not isinstance(exc, InfeasibleQuotasError):
-                raise
-            # household rows shrink the feasible set; the framework's
-            # relaxation MILP suggests the minimal quota adjustment (the
-            # reference's organizer loop, leximin.py:81-87) — apply and rerun
-            import dataclasses
+                if not isinstance(exc, InfeasibleQuotasError):
+                    raise
+                # household rows shrink the feasible set; the framework's
+                # relaxation MILP suggests the minimal quota adjustment (the
+                # reference's organizer loop, leximin.py:81-87) — apply, rerun
+                import dataclasses
 
-            repaired = {
-                cat: {f: exc.quotas[(cat, f)] for f in feats}
-                for cat, feats in hh_inst.categories.items()
+                repaired = {
+                    cat: {f: exc.quotas[(cat, f)] for f in feats}
+                    for cat, feats in inst_h.categories.items()
+                }
+                hh_dense, hh_space = featurize(
+                    dataclasses.replace(inst_h, categories=repaired)
+                )
+                hh = find_distribution_leximin(
+                    hh_dense, hh_space, households=households
+                )
+            el_h = time.time() - t0
+            quotient = build_household_quotient(hh_dense, households)
+            audit = audit_maximin(quotient.dense_aug, hh.allocation, hh.covered)
+            detail[tag] = {
+                "seconds": round(el_h, 1),
+                "alloc_linf_dev": round(
+                    float(abs(hh.allocation - hh.fixed_probabilities).max()), 8
+                ),
+                "min_prob": round(float(hh.allocation[hh.covered].min()), 6),
+                "household_classes": int(quotient.n_classes),
+                "exactness_audit": audit,
             }
-            hh_dense, hh_space = featurize(
-                dataclasses.replace(hh_inst, categories=repaired)
-            )
-            hh = find_distribution_leximin(hh_dense, hh_space, households=households)
-        el_h = time.time() - t0
-        detail["households_n400"] = {
-            "seconds": round(el_h, 1),
-            "alloc_linf_dev": round(
-                float(abs(hh.allocation - hh.fixed_probabilities).max()), 8
+
+        _run_households(
+            "households_n400",
+            skewed_instance(
+                n=400, k=40, n_categories=6, seed=2,
+                features_per_category=[2, 3, 4, 2, 3, 3],
             ),
-            "min_prob": round(float(hh.allocation[hh.covered].min()), 6),
-        }
+            np.arange(400) // 2,  # 200 two-person households
+        )
+        _run_households(
+            "households_n1200",
+            skewed_instance(
+                n=1200, k=110, n_categories=7, seed=2,
+                features_per_category=[2, 4, 5, 3, 2, 4, 6], skew=0.4,
+            ),
+            np.arange(1200) // 2,  # 600 couples — sf_e-class orbit count
+        )
 
     if os.environ.get("BENCH_SKIP_SAMPLER", "") != "1":
         # sampler throughput on the sf_e-shaped pool (the hot MC kernel)

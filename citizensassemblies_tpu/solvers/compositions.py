@@ -284,6 +284,46 @@ def leximin_over_compositions(
     )
 
 
+def _household_disjoint_pick(
+    scores: np.ndarray,
+    rot: np.ndarray,
+    houses: np.ndarray,
+    ct: int,
+    used: set,
+) -> np.ndarray:
+    """Indices of ``ct`` members maximizing ``scores`` (ties broken by
+    ``rot``) whose households are distinct from each other and from ``used``;
+    marks the chosen households used.
+
+    Conflicts only arise within one household class (a household's members
+    all carry the class in their augmented feature row — see
+    ``solvers/quotient.py``), and the class-cap quota row guarantees the
+    class's total duty count never exceeds its household count, so this
+    greedy always finds ``ct`` members: every class-``c`` orbit has a member
+    in each of the class's ``m_c`` households.
+    """
+    order = np.lexsort((rot, -scores))
+    picked: List[int] = []
+    for j in order:
+        h = int(houses[j])
+        if h in used:
+            continue
+        used.add(h)
+        picked.append(int(j))
+        if len(picked) == ct:
+            break
+    if len(picked) < ct:
+        # the input contract (class-cap quota rows) is violated; failing
+        # loudly beats emitting an undersized panel that would enter the
+        # distribution with positive probability
+        raise ValueError(
+            f"household-disjoint pick infeasible: needed {ct} members but "
+            f"only {len(picked)} households available — compositions violate "
+            "the quotient's class caps"
+        )
+    return np.asarray(picked, dtype=np.int64)
+
+
 def greedy_decompose(
     comps: np.ndarray,
     probs: np.ndarray,
@@ -291,6 +331,8 @@ def greedy_decompose(
     targets: np.ndarray,
     support_eps: float = 1e-11,
     max_panels: int = 16_384,
+    households: Optional[np.ndarray] = None,
+    delta_cap: float = 0.0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Water-filling decomposition of a composition distribution into panels.
 
@@ -301,6 +343,18 @@ def greedy_decompose(
     the largest step that overshoots no member. Exact up to float rounding on
     most instances (the caller verifies and LP-polishes any residual);
     portfolio size is typically O(Σ_t m_t/c_t) per support composition.
+
+    With ``households`` (int[n] group ids, on a household-quotient reduction —
+    ``solvers/quotient.py``), each slice's picks are additionally
+    household-disjoint, so every emitted panel honors the ≤1-per-household
+    constraint exactly (reference ``leximin.py:211-221``).
+
+    ``delta_cap`` (> 0) bounds each slice's probability mass: when the
+    mixture is a *basic* LP solution (sparse support, e.g. from an exact
+    host master), the natural need-driven steps are too coarse to mix
+    members — on a nexus-shaped instance (k/n ≈ 0.5) the uncapped greedy
+    leaves a 7e-3 residual that costs ~18 host-LP pricing rounds to polish,
+    while capping at ~tol yields residual ≈ 0.4·cap with no LP at all.
     """
     sel = probs > support_eps
     comps = comps[sel]
@@ -311,13 +365,34 @@ def greedy_decompose(
     msize = reduction.msize
     members = reduction.members
 
+    # serve compositions largest-first so late slices retain mixing freedom
+    order = np.argsort(-p)
+
+    # the slice loop is the host hot path (~90k per-type partial sorts on a
+    # nexus_170-shaped instance); the native slicer runs the identical
+    # algorithm ~100× faster, with the Python loop below as the reference
+    # implementation and fallback
+    from citizensassemblies_tpu.solvers.native_oracle import (
+        greedy_decompose_native,
+    )
+
+    per_type_need = np.array(
+        [targets[members[t][0]] if len(members[t]) else 0.0 for t in range(T)]
+    )
+    got = greedy_decompose_native(
+        reduction, comps[order], p[order], per_type_need,
+        max_panels, households=households, delta_cap=delta_cap,
+    )
+    if got is not None:
+        return got
+
+    house_of = (
+        [households[members[t]] for t in range(T)] if households is not None else None
+    )
     needs = [np.full(int(msize[t]), 0.0) for t in range(T)]
     for t in range(T):
         needs[t][:] = targets[members[t][0]] if len(members[t]) else 0.0
     cursors = np.zeros(T, dtype=np.int64)
-
-    # serve compositions largest-first so late slices retain mixing freedom
-    order = np.argsort(-p)
     panels: List[np.ndarray] = []
     pprobs: List[float] = []
     for s in order:
@@ -325,20 +400,27 @@ def greedy_decompose(
         rho = float(p[s])
         while rho > 1e-12 and len(panels) < max_panels:
             row = np.zeros(n, dtype=bool)
-            delta = rho
+            delta = min(rho, delta_cap) if delta_cap > 0 else rho
             chosen: List[Tuple[int, np.ndarray]] = []
+            used_houses: set = set()
             for t in range(T):
                 ct, mt = int(c[t]), int(msize[t])
                 if not ct:
                     continue
                 rot = (np.arange(mt) - cursors[t]) % mt
-                idx = np.lexsort((rot, -needs[t]))[:ct]
+                if house_of is None:
+                    idx = np.lexsort((rot, -needs[t]))[:ct]
+                else:
+                    idx = _household_disjoint_pick(
+                        needs[t], rot, house_of[t], ct, used_houses
+                    )
                 chosen.append((t, idx))
                 m = float(needs[t][idx].min())
                 if m > 1e-15:
                     delta = min(delta, m)
             if delta <= 1e-15:
-                delta = rho  # forced overshoot; the LP polish absorbs it
+                # forced overshoot; the LP polish absorbs it
+                delta = min(rho, delta_cap) if delta_cap > 0 else rho
             for t, idx in chosen:
                 row[members[t][idx]] = True
                 needs[t][idx] -= delta
@@ -359,6 +441,7 @@ def decompose_with_pricing(
     max_rounds: int = 200,
     log: Optional[RunLog] = None,
     tol: float = 1e-9,
+    households: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray, float]:
     """Exact panel decomposition of a composition distribution.
 
@@ -376,6 +459,11 @@ def decompose_with_pricing(
     (``leximin.py:420-424``). An exact decomposition always exists (uniform
     within-type selection is a finite convex combination of concrete panels),
     so ε converges to ~0. Returns ``(panels bool [R, n], probs, ε)``.
+
+    With ``households`` every emitted panel is household-disjoint; the
+    prefix-sum pricing value then upper-bounds the realized column's value
+    (the disjoint pick may have to skip a top member), so a stall guard
+    breaks the loop when ε stops improving instead of trusting the estimate.
     """
     log = log or RunLog(echo=False)
     n = reduction.n
@@ -387,7 +475,8 @@ def decompose_with_pricing(
     # tolerance, in which case no LP runs at all
     tol = max(tol, 1e-9)
     P0, q0 = greedy_decompose(
-        comps, probs, reduction, targets, support_eps=support_eps, max_panels=budget
+        comps, probs, reduction, targets, support_eps=support_eps,
+        max_panels=budget, households=households,
     )
     total = q0.sum()
     if abs(total - 1.0) < tol:
@@ -396,20 +485,52 @@ def decompose_with_pricing(
         dev = float(np.max(np.abs(targets - P0.T.astype(np.float64) @ q0)))
         if dev <= tol:
             return P0, q0 / total, max(dev, 0.0)
+        if tol >= 4e-5:
+            # coarse-slice failure mode (sparse basic mixtures at high k/n):
+            # retry once with capped slices — the cap equidistributes
+            # members (measured residual ≈ 0.4·cap), trading a larger
+            # portfolio for skipping the LP pricing loop entirely
+            P1, q1 = greedy_decompose(
+                comps, probs, reduction, targets, support_eps=support_eps,
+                max_panels=budget, households=households,
+                delta_cap=1.5 * tol,
+            )
+            t1 = q1.sum()
+            if abs(t1 - 1.0) < tol:
+                dev1 = float(
+                    np.max(np.abs(targets - P1.T.astype(np.float64) @ q1))
+                )
+                if dev1 <= tol:
+                    return P1, q1 / t1, max(dev1, 0.0)
+                if dev1 < dev:
+                    P0, q0, dev = P1, q1, dev1
     rows: List[np.ndarray] = [r for r in P0]
     seen = {r.tobytes() for r in rows}
 
     from citizensassemblies_tpu.solvers.highs_backend import solve_final_primal_lp_duals
 
-    add_per_round = 64  # closed-form pricing is ~free; bigger rounds halve
-    # the number of host LP solves, which are the loop's whole cost
+    add_per_round = 256  # closed-form pricing is ~free; bigger rounds cut
+    # the number of host LP solves, which are the loop's whole cost (64 made
+    # a nexus-class polish pay ~18 LP rounds for ~1150 columns)
     p = None
     eps_dev = 1.0
+    best_eps = np.inf
+    stalled = 0
     for _ in range(max_rounds):
         P = np.stack(rows, axis=0)
         p, eps_dev, y, mu = solve_final_primal_lp_duals(P, targets)
         if eps_dev <= tol:
             break
+        if households is not None:
+            # the pricing estimate below is only an upper bound under
+            # household disjointness — stop when realized columns no longer
+            # move ε rather than looping on phantom improvement
+            if eps_dev > best_eps - 1e-12:
+                stalled += 1
+                if stalled >= 8:
+                    break
+            else:
+                best_eps, stalled = eps_dev, 0
         # price: value(c) = Σ_t (sum of the c_t largest y within type t)
         prefix = np.zeros((T, maxm + 1))
         tops: List[np.ndarray] = []
@@ -425,10 +546,35 @@ def decompose_with_pricing(
         added = 0
         for ci in cand:
             row = np.zeros(n, dtype=bool)
-            for t in range(T):
-                ct = int(comps[ci, t])
-                if ct:
-                    row[tops[t][:ct]] = True
+            if households is None:
+                for t in range(T):
+                    ct = int(comps[ci, t])
+                    if ct:
+                        row[tops[t][:ct]] = True
+            else:
+                used_houses: set = set()
+                short = False
+                for t in range(T):
+                    ct = int(comps[ci, t])
+                    if not ct:
+                        continue
+                    # tops[t] is y-descending member ids; realize the duty
+                    # household-disjointly (skips cost at most the estimate)
+                    picked = 0
+                    for a in tops[t]:
+                        h = int(households[a])
+                        if h in used_houses:
+                            continue
+                        used_houses.add(h)
+                        row[a] = True
+                        picked += 1
+                        if picked == ct:
+                            break
+                    if picked < ct:
+                        short = True  # class caps violated for this column
+                        break
+                if short:
+                    continue  # never add an undersized panel
             kb = row.tobytes()
             if kb not in seen:
                 seen.add(kb)

@@ -273,10 +273,21 @@ def realize_profile(
     log = log or RunLog(echo=False)
     T = reduction.T
     m = reduction.msize.astype(np.float64)
+    if cfg is None:
+        from citizensassemblies_tpu.utils.config import default_config
+
+        cfg = default_config()
     if use_pdhg is None:
         import jax
 
         use_pdhg = jax.default_backend() not in ("cpu",)
+    accel = bool(use_pdhg)
+    if T <= cfg.decomp_host_master_max_types:
+        # small-T instances stay on host masters end to end: cap the column
+        # set so the expansion cannot push the master past the host's sweet
+        # spot (a 6k-column round paid a device round-trip OR a ~2 s host
+        # solve; the top-ranked ~1.5k neighbors carry the hull information)
+        master_cap = min(master_cap, cfg.decomp_host_master_max_cols)
 
     seen: Dict[bytes, int] = {}
     cols: List[np.ndarray] = []
@@ -334,10 +345,11 @@ def realize_profile(
     eps_hist: List[float] = []
     pdhg_warm = None
     best: Optional[Tuple[np.ndarray, np.ndarray, float]] = None
-    if cfg is None:
-        from citizensassemblies_tpu.utils.config import default_config
-
-        cfg = default_config()
+    t_start = time.time()
+    # the stalled-acceptance band the caller still accepts (cg_typespace
+    # accepts eps ≤ max(decomp_accept, decomp_accept_stalled) outright), so
+    # stopping inside it never triggers the stage-CG fallback
+    stalled_band = max(accept, getattr(cfg, "decomp_accept_stalled", accept))
     # f32 KKT tolerance for the approximate master: two orders below the
     # acceptance bar recovers the early exit once the warm-started iterate is
     # past the accuracy the (float64, arithmetic) accept check needs
@@ -364,6 +376,12 @@ def realize_profile(
             break
         C = np.stack(cols, axis=0)
         MT = np.ascontiguousarray((C.astype(np.float64) / m[None, :]).T)
+        # per-round master selection: small problems solve exactly on host
+        # faster than one accelerator round-trip; large ones want the device
+        use_pdhg = accel and (
+            T > cfg.decomp_host_master_max_types
+            or len(cols) > cfg.decomp_host_master_max_cols
+        )
         if use_pdhg:
             import jax
 
@@ -397,8 +415,18 @@ def realize_profile(
                 lp_solves += 1
             # end-game: the approximate objective says the support should be
             # able to realize v, but the first-order iterate's own residual
-            # still lags — extract the exact optimum once on the support
-            near = eps <= accept * 1.25 or eps_obj <= accept * 1.05
+            # still lags — extract the exact optimum once on the support.
+            # Deep into the time budget the trigger widens: a polish costs
+            # one host solve, while every further round costs a master PLUS
+            # pricing, so gambling on an early exact extraction is the
+            # cheaper branch once the loop is slow-converging (r3's 150 s
+            # tail rep was exactly this regime)
+            deep = time.time() - t_start > 0.6 * cfg.decomp_time_budget_s
+            near = (
+                eps <= accept * 1.25
+                or eps_obj <= accept * 1.05
+                or (deep and (eps <= 2.0 * accept or eps_obj <= 1.4 * accept))
+            )
             if eps > accept and near and rnd >= polish_after:
                 C_sup, p_sup, eps_sup = polish_support(p)
                 log.emit(
@@ -424,6 +452,19 @@ def realize_profile(
         eps_hist.append(eps)
         if best is None or eps < best[2]:
             best = (C, p, eps)
+        if (
+            time.time() - t_start > cfg.decomp_time_budget_s
+            and best[2] <= stalled_band
+            and eps > accept
+        ):
+            # budget exhausted with a residual the caller accepts anyway:
+            # stop grinding rounds and let the end-game polish extract the
+            # best support (bounds the worst-of-N tail)
+            log.emit(
+                f"  face rounds over time budget ({cfg.decomp_time_budget_s:.0f}s) "
+                f"with best ε={best[2]:.2e} inside the stalled band; stopping."
+            )
+            break
         if eps <= accept:
             # return this certified master as-is: the certificate is the
             # arithmetic residual of p itself, independent of the solver
@@ -457,6 +498,23 @@ def realize_profile(
             cand.append(
                 neighbor_columns(np.stack(kept[:512]), reduction, r_norm)
             )
+        if (
+            T <= cfg.decomp_host_master_max_types
+            and rnd == 0
+            and eps <= 6 * accept
+        ):
+            # small-T near-miss after the first master: a deeper aimed-slice
+            # pass (finer apportionment of the same target, new tie-break
+            # streams) closes the hull in one host round where generic
+            # neighbors needed a 6k-column expansion (sf_d-class: R=2048
+            # slices certify at ε 4.4e-4 vs 1.1e-3 from the 1024 injection)
+            from citizensassemblies_tpu.solvers.cg_typespace import (
+                _slice_relaxation,
+            )
+
+            deep_slices = _slice_relaxation(v * m, reduction, R=2048)
+            if deep_slices:
+                cand.append(np.stack(deep_slices).astype(np.int16))
         # exact anchors: best compositions against the dual direction — these
         # are *compound* moves no single swap reaches. The noisy variants
         # only diversify, so they run on alternate rounds; the forced-
@@ -495,8 +553,9 @@ def realize_profile(
             cap = max(256, master_cap - len(cols))
             for i in order[:cap]:
                 added += add(batch[i])
+        obj_note = f" obj≈{eps_obj:.2e}" if use_pdhg else ""
         log.emit(
-            f"  face round {rnd + 1}: ε={eps:.2e} added {added} "
+            f"  face round {rnd + 1}: ε={eps:.2e}{obj_note} added {added} "
             f"(master {base}+{added}, {time.time() - t_round:.1f}s)."
         )
         if added == 0:

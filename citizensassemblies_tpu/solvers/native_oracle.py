@@ -253,3 +253,104 @@ def repair_slice_native(
         ctypes.c_uint32(seed & 0xFFFFFFFF), int(max_passes),
     )
     return bool(ok)
+
+# --- native water-filling slicer (greedy_decompose's host hot loop) ---------
+
+_SLICER_SRC = os.path.join(_REPO_ROOT, "native", "slicer.cpp")
+_SLICER_SO = os.path.join(_REPO_ROOT, "native", "build", "libslicer.so")
+_slicer_lib = None
+_slicer_failed = False
+
+
+def _load_slicer() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the slicer library; None if unavailable."""
+    global _slicer_lib, _slicer_failed
+    with _lock:
+        if _slicer_lib is not None or _slicer_failed:
+            return _slicer_lib
+        try:
+            lib = _compile_and_load(_SLICER_SRC, _SLICER_SO)
+            lib.slicer_decompose.restype = ctypes.c_int
+            lib.slicer_decompose.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),   # comps
+                ctypes.POINTER(ctypes.c_double),  # probs
+                ctypes.POINTER(ctypes.c_int32),   # members_flat
+                ctypes.POINTER(ctypes.c_int32),   # member_off
+                ctypes.POINTER(ctypes.c_int32),   # houses_flat (or NULL)
+                ctypes.c_int,                     # n_houses
+                ctypes.POINTER(ctypes.c_double),  # needs_flat (in/out)
+                ctypes.c_double,                  # delta_cap (<=0: uncapped)
+                ctypes.c_int,                     # max_panels
+                ctypes.POINTER(ctypes.c_uint8),   # out_panels
+                ctypes.POINTER(ctypes.c_double),  # out_probs
+                ctypes.POINTER(ctypes.c_int),     # out_count
+            ]
+            _slicer_lib = lib
+        except Exception:
+            _slicer_failed = True
+            _slicer_lib = None
+        return _slicer_lib
+
+
+def greedy_decompose_native(
+    reduction: "TypeReduction",
+    comps_sorted: np.ndarray,
+    probs_sorted: np.ndarray,
+    per_type_need: np.ndarray,
+    max_panels: int,
+    households: Optional[np.ndarray] = None,
+    delta_cap: float = 0.0,
+):
+    """Native water-filling decomposition (``native/slicer.cpp``) with the
+    exact semantics of the Python loop in ``compositions.greedy_decompose``
+    (same sort keys, cursor rotation, forced-overshoot rule). ``comps_sorted``
+    /``probs_sorted`` must already be support-filtered and ordered largest
+    mass first; ``per_type_need`` is the initial need per type (equal across
+    a type's members). Returns ``(panels bool [R, n], probs)`` or None when
+    the library is unavailable (callers then run the Python loop)."""
+    lib = _load_slicer()
+    if lib is None:
+        return None
+    T, n = reduction.T, reduction.n
+    S = len(probs_sorted)
+    comps = np.ascontiguousarray(comps_sorted, dtype=np.int32)
+    probs = np.ascontiguousarray(probs_sorted, dtype=np.float64)
+    sizes = np.array([len(m) for m in reduction.members], dtype=np.int64)
+    member_off = np.zeros(T + 1, dtype=np.int32)
+    member_off[1:] = np.cumsum(sizes).astype(np.int32)
+    members_flat = (
+        np.concatenate(reduction.members).astype(np.int32)
+        if T
+        else np.zeros(0, np.int32)
+    )
+    needs_flat = np.repeat(
+        np.asarray(per_type_need, dtype=np.float64), sizes
+    )
+    needs_flat = np.ascontiguousarray(needs_flat)
+    if households is not None:
+        houses_flat = np.ascontiguousarray(
+            np.asarray(households)[members_flat], dtype=np.int32
+        )
+        houses_ptr = _ptr(houses_flat, ctypes.c_int32)
+        n_houses = int(np.asarray(households).max()) + 1
+    else:
+        houses_ptr = None
+        n_houses = 0
+    out_panels = np.zeros((max_panels, n), dtype=np.uint8)
+    out_probs = np.zeros(max_panels, dtype=np.float64)
+    out_count = ctypes.c_int(0)
+    rc = lib.slicer_decompose(
+        T, n, S,
+        _ptr(comps, ctypes.c_int32), _ptr(probs, ctypes.c_double),
+        _ptr(members_flat, ctypes.c_int32), _ptr(member_off, ctypes.c_int32),
+        houses_ptr, n_houses,
+        _ptr(needs_flat, ctypes.c_double),
+        float(delta_cap), int(max_panels),
+        _ptr(out_panels, ctypes.c_uint8), _ptr(out_probs, ctypes.c_double),
+        ctypes.byref(out_count),
+    )
+    if rc != 0:
+        return None
+    R = int(out_count.value)
+    return out_panels[:R].astype(bool), out_probs[:R].copy()

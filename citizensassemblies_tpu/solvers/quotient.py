@@ -1,0 +1,128 @@
+"""Household quotient reduction: type-space LEXIMIN under household constraints.
+
+The reference treats household ("same address") constraints as a reason to stay
+in agent space forever (its ILPs simply add ≤1-per-household rows,
+``leximin.py:211-221``), which makes household runs as slow as the unconstrained
+ones. But households preserve a *quotient* symmetry the agent-space view hides:
+
+* Group agents by feature row → base types (as in the unconstrained reduction).
+* Group households by the **multiset of their members' base types** → household
+  *classes*; class ``c`` has ``m_c`` structurally identical households.
+* Two agents are interchangeable (an instance automorphism maps one to the
+  other) iff they have the same base type AND their households belong to the
+  same class — the orbits are (class, base type) pairs.
+
+The leximin allocation is the unique optimum of a symmetric problem, hence
+orbit-constant, so the problem collapses onto orbits exactly as the
+unconstrained one collapses onto types. The key structural fact making the
+existing type-space machinery reusable *unchanged*:
+
+    A per-orbit selection count vector ``x`` is realizable by a
+    household-disjoint panel  ⇔  it satisfies the feature quotas, ``Σx = k``,
+    and the per-class cap ``Σ_{t ∈ c} x_{c,t} ≤ m_c``.
+
+(⇐: pick ``Σ_t x_{c,t} ≤ m_c`` distinct class-``c`` households and give
+``x_{c,t}`` of them type-``t`` duty — every class-``c`` household has a member
+of every type in the class multiset, so any assignment works. ⇒: a
+household-disjoint panel touches each household at most once.)
+
+The class caps are plain one-sided quota rows, so the whole pipeline —
+enumeration, relaxation leximin, probe certification, composition CG, face
+decomposition, native B&B pricing — runs on an **augmented instance** whose
+incidence matrix gains one "household class" category (one-hot class
+membership, quotas ``[0, m_c]``). Distinct augmented rows ARE the orbits, and
+the orbit sizes (``m_c·r_{c,t}`` agents) fall out of the standard
+``TypeReduction`` automatically. Only panel *realization* — turning per-orbit
+counts into concrete members — needs to know about households: within one
+panel, picks across a class's orbits must land in distinct households (see
+``compositions.greedy_decompose`` / ``decompose_with_pricing``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import DenseInstance, HostView
+
+
+@dataclasses.dataclass
+class HouseholdQuotient:
+    """The augmented instance plus the household bookkeeping realization needs."""
+
+    dense_aug: DenseInstance
+    households: np.ndarray  # int32[n] compacted household id per agent
+    class_of_household: np.ndarray  # int32[H] class id per household
+    class_size: np.ndarray  # int32[C] households per class (m_c)
+    class_feature_base: int  # first augmented column index (= original F)
+    n_classes: int
+
+
+def build_household_quotient(
+    dense: DenseInstance, households: np.ndarray
+) -> HouseholdQuotient:
+    """Build the augmented instance for the household quotient.
+
+    ``households`` is any int array of group labels (as produced by
+    ``core.instance.compute_households``); it is compacted to 0..H-1.
+    """
+    A = dense.A_np
+    n, F = A.shape
+    hh = np.asarray(households)
+    assert hh.shape == (n,), "households must label every agent"
+    _, hh = np.unique(hh, return_inverse=True)
+    H = int(hh.max()) + 1 if n else 0
+
+    # base types by feature row (the unconstrained reduction's grouping)
+    _, base_type = np.unique(A, axis=0, return_inverse=True)
+
+    # class signature per household: sorted multiset of member base types.
+    # Size-1 households of the same base type share a class, so singleton
+    # agents keep collapsing onto types instead of splintering into
+    # per-agent orbits.
+    members_of_hh: Dict[int, list] = {h: [] for h in range(H)}
+    for i in range(n):
+        members_of_hh[int(hh[i])].append(int(base_type[i]))
+    sig_to_class: Dict[Tuple[int, ...], int] = {}
+    class_of_household = np.zeros(H, dtype=np.int32)
+    for h in range(H):
+        sig = tuple(sorted(members_of_hh[h]))
+        if sig not in sig_to_class:
+            sig_to_class[sig] = len(sig_to_class)
+        class_of_household[h] = sig_to_class[sig]
+    C = len(sig_to_class)
+    class_size = np.bincount(class_of_household, minlength=C).astype(np.int32)
+
+    cls_of_agent = class_of_household[hh]
+    A_aug = np.zeros((n, F + C), dtype=bool)
+    A_aug[:, :F] = A
+    A_aug[np.arange(n), F + cls_of_agent] = True
+
+    qmin_aug = np.concatenate([dense.qmin_np, np.zeros(C, dtype=np.int32)])
+    qmax_aug = np.concatenate([dense.qmax_np, class_size])
+    cat_aug = np.concatenate(
+        [
+            np.asarray(dense.cat_of_feature, dtype=np.int32),
+            np.full(C, dense.n_categories, dtype=np.int32),
+        ]
+    )
+    dense_aug = DenseInstance(
+        A=jnp.asarray(A_aug),
+        qmin=jnp.asarray(qmin_aug, dtype=jnp.int32),
+        qmax=jnp.asarray(qmax_aug, dtype=jnp.int32),
+        cat_of_feature=jnp.asarray(cat_aug, dtype=jnp.int32),
+        k=dense.k,
+        n_categories=dense.n_categories + 1,
+        host=HostView(A_aug, qmin_aug.astype(np.int32), qmax_aug.astype(np.int32)),
+    )
+    return HouseholdQuotient(
+        dense_aug=dense_aug,
+        households=hh.astype(np.int32),
+        class_of_household=class_of_household,
+        class_size=class_size,
+        class_feature_base=F,
+        n_classes=C,
+    )

@@ -103,6 +103,18 @@ class Config:
     #: pricing rounds attempted for the decomposition before falling back to
     #: stage-wise column generation.
     decomp_max_rounds: int = 60
+    #: the face master runs on the host LP instead of device PDHG when BOTH
+    #: the type count and the column count are small: each device call pays
+    #: the accelerator round-trip (through a TPU tunnel, ~0.5 s per master
+    #: on a 95-type instance) but a host HiGHS solve scales with T×columns
+    #: (measured ~1.9 s at 154×6000, where PDHG wins again).
+    decomp_host_master_max_types: int = 384
+    decomp_host_master_max_cols: int = 2_500
+    #: wall-clock budget for the face-round loop: past it, a best residual
+    #: already inside the stalled-acceptance band stops the loop (end-game
+    #: polish still runs), bounding the tail a slow-converging hull can add
+    #: — the r3 flagship showed a 150 s worst-of-3 against a 62 s median.
+    decomp_time_budget_s: float = 45.0
     #: exact MILP pricing calls per decomposition round, at randomly perturbed
     #: duals — each returns an extreme point of the composition polytope,
     #: which grows the master's hull far faster than interior samples.

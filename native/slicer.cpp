@@ -1,0 +1,127 @@
+// Native water-filling slicer: the hot loop of
+// citizensassemblies_tpu/solvers/compositions.py::greedy_decompose.
+//
+// Decomposes a distribution over type-space compositions into concrete
+// panels: each slice picks, per type, the c_t members with the largest
+// remaining need (need = target selection probability not yet realized),
+// ties rotated by a per-type cursor; the slice's probability is the largest
+// step that overshoots no chosen member. Semantics mirror the Python
+// reference implementation exactly (same sort keys, same cursor updates) so
+// the two can be cross-checked; the Python loop costs seconds at
+// reference-benchmark shapes (e.g. ~2.5 s on a nexus_170-shaped instance,
+// ~90k per-type partial sorts) while this loop is ~100x faster.
+//
+// Household mode (houses != nullptr): within one slice the picks are
+// additionally household-disjoint — the quotient reduction's class-cap
+// quota rows (solvers/quotient.py) guarantee a disjoint assignment exists,
+// and the scan simply skips members of already-used households. Returns -2
+// if a pick cannot be completed (caps violated upstream); the caller falls
+// back to the Python implementation.
+//
+// C ABI only — loaded with ctypes (no pybind11 in this toolchain).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" int slicer_decompose(
+    int T, int n, int S,
+    const int32_t* comps,        // [S, T] compositions, caller-sorted by -prob
+    const double* probs,         // [S] composition probabilities
+    const int32_t* members_flat, // member agent ids, concatenated per type
+    const int32_t* member_off,   // [T+1] offsets into members_flat
+    const int32_t* houses_flat,  // household id per member (same layout) or null
+    int n_houses,                // households overall (size of used bitmap)
+    double* needs_flat,          // per-member remaining need (in/out)
+    double delta_cap,            // max slice mass (<=0: uncapped); capping
+                                 // equidistributes members when the support
+                                 // is a basic (sparse) LP solution whose
+                                 // natural slices are too coarse to mix
+    int max_panels,
+    uint8_t* out_panels,         // [max_panels, n] row-major
+    double* out_probs,           // [max_panels]
+    int* out_count) {
+  std::vector<int64_t> cursors(T, 0);
+  std::vector<int32_t> idx_buf;
+  std::vector<int32_t> chosen_types;
+  std::vector<std::pair<int32_t, int32_t>> chosen; // (type, member slot)
+  std::vector<uint8_t> house_used(houses_flat ? n_houses : 0, 0);
+  std::vector<int32_t> touched;
+  int count = 0;
+
+  for (int s = 0; s < S; ++s) {
+    double rho = probs[s];
+    const int32_t* c = comps + (int64_t)s * T;
+    while (rho > 1e-12 && count < max_panels) {
+      double delta = (delta_cap > 0.0) ? std::min(rho, delta_cap) : rho;
+      chosen.clear();
+      if (houses_flat) {
+        for (int32_t h : touched) house_used[h] = 0;
+        touched.clear();
+      }
+      for (int t = 0; t < T; ++t) {
+        int ct = c[t];
+        if (!ct) continue;
+        int off = member_off[t];
+        int mt = member_off[t + 1] - off;
+        if (ct > mt) return -2; // caps violated upstream — caller falls back
+        const double* need = needs_flat + off;
+        int64_t cur = cursors[t];
+        idx_buf.resize(mt);
+        for (int j = 0; j < mt; ++j) idx_buf[j] = j;
+        // order by (need desc, rotation asc); rotation = (j - cursor) mod mt
+        auto rot = [cur, mt](int j) { return (int)(((int64_t)j - cur) % mt + mt) % mt; };
+        auto cmp = [&](int a, int b) {
+          if (need[a] != need[b]) return need[a] > need[b];
+          return rot(a) < rot(b);
+        };
+        int picked = 0;
+        if (!houses_flat) {
+          if (ct < mt)
+            std::partial_sort(idx_buf.begin(), idx_buf.begin() + ct,
+                              idx_buf.end(), cmp);
+          for (int j = 0; j < ct; ++j)
+            chosen.emplace_back(t, off + idx_buf[j]);
+          picked = std::min(ct, mt);
+        } else {
+          std::sort(idx_buf.begin(), idx_buf.end(), cmp);
+          const int32_t* house = houses_flat + off;
+          for (int j = 0; j < mt && picked < ct; ++j) {
+            int32_t h = house[idx_buf[j]];
+            if (house_used[h]) continue;
+            house_used[h] = 1;
+            touched.push_back(h);
+            chosen.emplace_back(t, off + idx_buf[j]);
+            ++picked;
+          }
+        }
+        if (picked < ct) return -2; // caps violated upstream — caller falls back
+        double mn = needs_flat[chosen[chosen.size() - ct].second];
+        for (size_t q = chosen.size() - ct; q < chosen.size(); ++q)
+          mn = std::min(mn, needs_flat[chosen[q].second]);
+        if (mn > 1e-15) delta = std::min(delta, mn);
+      }
+      if (delta <= 1e-15)
+        delta = (delta_cap > 0.0) ? std::min(rho, delta_cap)
+                                : rho; // forced overshoot; LP polish absorbs it
+      uint8_t* row = out_panels + (int64_t)count * n;
+      std::memset(row, 0, n);
+      for (auto& tc : chosen) {
+        row[members_flat[tc.second]] = 1;
+        needs_flat[tc.second] -= delta;
+      }
+      for (int t = 0; t < T; ++t) {
+        int ct = c[t];
+        if (!ct) continue;
+        int mt = member_off[t + 1] - member_off[t];
+        if (mt > 0) cursors[t] = (cursors[t] + ct) % mt;
+      }
+      out_probs[count++] = delta;
+      rho -= delta;
+    }
+    if (count >= max_panels) break;
+  }
+  *out_count = count;
+  return 0;
+}
