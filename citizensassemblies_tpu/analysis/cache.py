@@ -76,7 +76,7 @@ _KEY_FIELDS = {
         "eps", "fixed_prob_relax_step", "support_eps", "mw_rounds_factor",
         "pricing_batch", "seed_batch",
         "cg_columns_per_round", "max_portfolio", "pdhg_max_iters", "pdhg_tol",
-        "backend", "solver_seed",
+        "backend", "solver_seed", "force_agent_space",
     ),
 }
 _KEY_FIELDS["xmin"] = _KEY_FIELDS["leximin"] + (

@@ -393,7 +393,7 @@ def find_distribution_leximin(
     # rows on an augmented instance (see ``solvers/quotient.py``) — so the
     # same pipeline runs, with household-disjoint panel realization. A valid
     # mid-run agent-space checkpoint means CG work exists to resume, honor it.
-    if not initial_panels:
+    if not initial_panels and not cfg.force_agent_space:
         has_ckpt = checkpoint_path is not None and (
             load_cg_state(checkpoint_path, n, problem_fingerprint(dense, cfg, households))
             is not None

@@ -292,6 +292,7 @@ def _leximin_relaxation(
     reduction: TypeReduction,
     log: Optional[RunLog] = None,
     probe_tol: float = 1e-7,
+    exclude: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Exact leximin of ``x/m`` over the marginal relaxation polytope
     ``X = {x ∈ [0, m] : Σx = k, lo ≤ tfᵀx ≤ hi}``.
@@ -315,12 +316,22 @@ def _leximin_relaxation(
     the face); otherwise per-candidate probes keep exactly the types whose face
     maximum is ``z``. Returns ``(v [T] leximin type values, x_final [T] an
     optimal marginal)``.
+
+    ``exclude`` (bool[T]) pins types proven to appear in NO integer
+    composition at value 0 with ``x_t = 0``: leaving them free lets the
+    relaxation route mass through them fractionally, inflating other types'
+    values past what any composition mixture can realize (the face
+    decomposition then stalls on an irreducible residual).
     """
     log = log or RunLog(echo=False)
     T, F = reduction.T, reduction.F
     m = reduction.msize.astype(np.float64)
+    if exclude is not None and exclude.any():
+        m = np.where(exclude, 0.0, m)  # upper bound 0 ⇒ x_t = 0 throughout
     k = float(reduction.k)
     fixed = np.full(T, -1.0)
+    if exclude is not None:
+        fixed[exclude] = 0.0
     x_last = np.zeros(T)
     quota_A, quota_b = _quota_system(reduction)
     stage = 0
@@ -759,19 +770,73 @@ def leximin_cg_typespace(
     # panel kernel never compiles on this path (the reference's coverage
     # phase is per-uncovered-agent ILPs, leximin.py:279-289).
     if resumed is None:
+        # Fractional coverage (v_relax > 0) does NOT imply integer coverage:
+        # a type can carry relaxation mass yet appear in no integer
+        # composition (observed en masse on tight repaired-quota household
+        # instances — 171 of 400 agents), in which case the decomposition
+        # target is unrealizable and the face loop stalls into the stage-CG
+        # fallback. Certify every type by integer evidence — membership in
+        # an aimed slice, or one exact forced-inclusion MILP — and re-run
+        # the relaxation with proven-uncoverable types pinned to x_t = 0.
         with log.timer("relax_leximin"):
-            v_relax, _ = _leximin_relaxation(reduction, log, probe_tol=cfg.probe_tol)
-        with log.timer("seed"):
-            coverable = v_relax > 1e-9
-            for t in np.nonzero(~coverable)[0]:
-                got = oracle.maximize(np.zeros(T), forced_type=int(t))
-                if got is None:
-                    continue
-                add_comp(got[0])
-                coverable[t] = True
+            excluded = np.zeros(T, dtype=bool)
+            # integer-coverage evidence persists across rounds: a forced-
+            # inclusion MILP's verdict cannot change when more types get
+            # excluded (excluding only shrinks the polytope for OTHERS, and
+            # a witness composition never contains an excluded type), so
+            # certified/refuted types are never re-solved
+            int_certified = np.zeros(T, dtype=bool)
+            int_refuted = np.zeros(T, dtype=bool)
+            probe_solves = 0
+            for _cov_round in range(4):
+                v_relax, _ = _leximin_relaxation(
+                    reduction, log, probe_tol=cfg.probe_tol,
+                    exclude=excluded if excluded.any() else None,
+                )
+                frac_cov = v_relax > 1e-9
+                # integer evidence from a cheap aimed-slice pass
+                trial = _slice_relaxation(v_relax * msize, reduction, R=256)
+                present = (
+                    np.any(np.stack(trial) > 0, axis=0)
+                    if trial
+                    else np.zeros(T, dtype=bool)
+                ) | int_certified
+                newly_uncoverable = []
+                for t in np.nonzero(~present & ~excluded & ~int_refuted)[0]:
+                    got = oracle.maximize(np.zeros(T), forced_type=int(t))
+                    probe_solves += 1
+                    if got is None:
+                        int_refuted[t] = True
+                        if frac_cov[t]:
+                            newly_uncoverable.append(int(t))
+                    else:
+                        add_comp(got[0])
+                        present[t] = True
+                        int_certified[t] = True
+                if not newly_uncoverable:
+                    break
+                excluded[newly_uncoverable] = True
+                log.emit(
+                    f"Coverage round {_cov_round + 1}: "
+                    f"{len(newly_uncoverable)} fractionally-covered type(s) "
+                    "proven integer-uncoverable; re-running the relaxation "
+                    "with them excluded."
+                )
+            else:
+                # the round budget ended ON an exclusion: the target must
+                # still be recomputed without the just-excluded mass or the
+                # decomposition chases an unrealizable profile
+                v_relax, _ = _leximin_relaxation(
+                    reduction, log, probe_tol=cfg.probe_tol, exclude=excluded
+                )
+            coverable = (present | (v_relax > 1e-9)) & ~excluded
+            # the certification slices aim at the final target — keep them
+            # as seed columns (the main injection below dedups against them)
+            for c in trial:
+                add_comp(c)
             log.emit(
                 f"Coverage: {int(coverable.sum())}/{T} types coverable "
-                f"(relaxation profile + {int((v_relax <= 1e-9).sum())} probe solves)."
+                f"(integer-certified; {probe_solves} probe solves)."
             )
     else:
         for c in resumed.compositions:

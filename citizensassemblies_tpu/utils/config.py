@@ -174,6 +174,13 @@ class Config:
     #: "highs" (host scipy/HiGHS LPs and MILPs — the cross-check backend), or
     #: "hybrid" (TPU inner loops, host exact certification).
     backend: str = "hybrid"
+    #: bypass the type-space/quotient solvers and run the agent-space CG
+    #: (the reference's only mode, ``leximin.py:338-470``) even when a
+    #: symmetry collapse applies. This is the independent cross-check oracle
+    #: the certification tests diff the production path against — before the
+    #: household quotient existed they forced agent space with singleton
+    #: households, which the quotient now (correctly) collapses right back.
+    force_agent_space: bool = False
     #: random seed used by solver-internal sampling (not MC estimation).
     solver_seed: int = 0
 

@@ -16,6 +16,7 @@ from citizensassemblies_tpu.core.instance import (
 )
 from citizensassemblies_tpu.models.leximin import find_distribution_leximin
 from citizensassemblies_tpu.ops.stats import prob_allocation_stats
+from citizensassemblies_tpu.utils.config import default_config
 
 
 def brute_force_leximin(A, qmin, qmax, k):
@@ -181,8 +182,12 @@ def test_uncoverable_agent_prefixed_zero_agent_space():
         name="uncoverable",
     )
     dense, space = featurize(inst)
-    # agent-space path via singleton households
-    dist = find_distribution_leximin(dense, space, households=np.arange(12))
+    # the agent-space path must be requested explicitly: singleton households
+    # no longer force it (the household quotient collapses them back)
+    dist = find_distribution_leximin(
+        dense, space,
+        cfg=default_config().replace(force_agent_space=True),
+    )
     assert dist.allocation[0] == 0.0
     assert not dist.covered[0]
     assert dist.fixed_probabilities[0] == 0.0

@@ -183,17 +183,19 @@ def test_production_dual_solve_routes_through_sharded_pdhg(dense):
     try:
         dist = find_distribution_leximin(
             dense,
-            cfg=default_config().replace(dual_shard_min_rows=1),
-            households=np.arange(dense.n),  # singleton households: same
-            # problem, forces the agent-space CG whose dual LP is routed
+            # force_agent_space: the agent-space CG is whose dual LP is
+            # routed; singleton households no longer force it (the household
+            # quotient collapses them back to type space)
+            cfg=default_config().replace(
+                dual_shard_min_rows=1, force_agent_space=True
+            ),
         )
     finally:
         par_solver.solve_dual_lp_pdhg_sharded = orig
     assert calls["n"] > 0, "sharded dual path never taken"
     host = find_distribution_leximin(
         dense,
-        cfg=default_config().replace(backend="highs"),
-        households=np.arange(dense.n),
+        cfg=default_config().replace(backend="highs", force_agent_space=True),
     )
     np.testing.assert_allclose(
         np.sort(dist.allocation), np.sort(host.allocation), atol=1e-3
