@@ -199,6 +199,7 @@ def _marginal_probe_confirm(
     probe_tol: float = 1e-7,
     floor_slack: float = _SLACK,
     log: Optional[RunLog] = None,
+    exclude: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Certify which candidate types are capped at ``z`` on the *marginal*
     optimal face ``{x ∈ X : x_u ≥ z·m_u ∀ unfixed u, x_f ≥ f·m_f}``.
@@ -213,6 +214,13 @@ def _marginal_probe_confirm(
     """
     T = reduction.T
     m = reduction.msize.astype(np.float64)
+    if exclude is not None and exclude.any():
+        # mirror the stage LP's pinning (x_t = 0): leaving the full upper
+        # bound would let the probe face route mass through excluded types —
+        # a strictly larger polytope than the one being optimized, whose
+        # probes can then fail on genuinely tight candidates and push the
+        # stage into the uncertified dual-heuristic fallback
+        m = np.where(exclude, 0.0, m)
     k = float(reduction.k)
     quota_A, quota_b = _quota_system(reduction)
     unfixed = fixed < 0
@@ -391,7 +399,7 @@ def _leximin_relaxation(
 
         conf = _marginal_probe_confirm(
             reduction, fixed, z, uidx[cand], probe_tol, floor_slack=floor_slack,
-            log=log,
+            log=log, exclude=exclude,
         )
         probes += 1 + (0 if conf.all() else len(cand))
         confirmed = np.zeros(T, dtype=bool)
@@ -405,7 +413,7 @@ def _leximin_relaxation(
             for t in rest:
                 if _marginal_probe_confirm(
                     reduction, fixed, z, np.array([t]), probe_tol,
-                    floor_slack=floor_slack, log=log,
+                    floor_slack=floor_slack, log=log, exclude=exclude,
                 )[0]:
                     confirmed[t] = True
                     break
@@ -788,12 +796,21 @@ def leximin_cg_typespace(
             int_certified = np.zeros(T, dtype=bool)
             int_refuted = np.zeros(T, dtype=bool)
             probe_solves = 0
-            for _cov_round in range(4):
+            # exclusion grows monotonically, so the loop terminates; 8
+            # rounds is a generous bound (rounds after the first mostly pay
+            # only the T-var relaxation re-run — refuted types regaining
+            # mass re-exclude WITHOUT new MILP solves)
+            for _cov_round in range(8):
                 v_relax, _ = _leximin_relaxation(
                     reduction, log, probe_tol=cfg.probe_tol,
                     exclude=excluded if excluded.any() else None,
                 )
                 frac_cov = v_relax > 1e-9
+                # a refuted type that regained relaxation mass after other
+                # exclusions re-routed it must be excluded too (its MILP
+                # verdict is permanent)
+                regained = int_refuted & frac_cov & ~excluded
+                newly_uncoverable = list(np.nonzero(regained)[0].astype(int))
                 # integer evidence from a cheap aimed-slice pass
                 trial = _slice_relaxation(v_relax * msize, reduction, R=256)
                 present = (
@@ -801,7 +818,6 @@ def leximin_cg_typespace(
                     if trial
                     else np.zeros(T, dtype=bool)
                 ) | int_certified
-                newly_uncoverable = []
                 for t in np.nonzero(~present & ~excluded & ~int_refuted)[0]:
                     got = oracle.maximize(np.zeros(T), forced_type=int(t))
                     probe_solves += 1
@@ -829,7 +845,9 @@ def leximin_cg_typespace(
                 v_relax, _ = _leximin_relaxation(
                     reduction, log, probe_tol=cfg.probe_tol, exclude=excluded
                 )
-            coverable = (present | (v_relax > 1e-9)) & ~excluded
+            # int-refuted types are never coverable regardless of the mass
+            # the final relaxation left on them
+            coverable = (present | (v_relax > 1e-9)) & ~excluded & ~int_refuted
             # the certification slices aim at the final target — keep them
             # as seed columns (the main injection below dedups against them)
             for c in trial:
