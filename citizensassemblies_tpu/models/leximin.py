@@ -295,7 +295,7 @@ def _typespace_leximin(
                 # 1e-9 cost ~30 extra host LPs for precision nothing
                 # downstream can see); the CG path floors the panel
                 # tolerance at 2e-5 (its greedy noise scale). On LARGE CG
-                # instances (n ≥ 256, where each polish LP costs ~1 s and
+                # instances (n ≥ 200, where each polish LP costs ~1 s and
                 # a nexus-class shape needed ~18 of them) the tolerance
                 # never drops below 2.5e-4 just because the mixture's own ε
                 # is tiny — precision the 1e-3 contract cannot see; small
@@ -304,13 +304,15 @@ def _typespace_leximin(
                 # contract error |alloc − v| ≤ tol_panel + eps_dev ≤
                 # accept_band + 1e-4 (= 9e-4 < 1e-3 at the default config;
                 # derived from cfg so the knobs cannot silently drift past
-                # the contract)
+                # the contract). The n ≥ 200 gate keeps reference-scale
+                # pools (hd_30's n=239 upward) out of the polish loop while
+                # the small test instances stay at the tight bound.
                 tol=max(
                     1e-6 if comps is not None else 2e-5,
                     min(
                         max(
                             0.5 * getattr(ts, "eps_dev", 0.0),
-                            2.5e-4 if comps is None and dense.n >= 256 else 0.0,
+                            2.5e-4 if comps is None and dense.n >= 200 else 0.0,
                         ),
                         max(cfg.decomp_accept, cfg.decomp_accept_stalled)
                         + 1e-4

@@ -227,14 +227,18 @@ def _marginal_probe_confirm(
     # the stage LP's unfixed floors are EXACT (x_u ≥ z·m_u rows, no slack),
     # so its optimum provably lies on the face with floors z − probe_relax
     # for any probe_relax > 0 — only solver feasibility tolerance needs
-    # covering, not the fixing margin; the floor is HiGHS's ~1e-7 primal
-    # feasibility tolerance (anything lower and the stage optimum can
-    # violate the face floors by more than the relaxation, rendering the
-    # face numerically empty and burning slack-ladder escalations). A loose
-    # face (the old margin+slack relaxation) freed (margin+slack)·Σm ≈
-    # 1e-4-scale reroutable mass, which made every sound group-probe budget
-    # negative and degraded tranche certification to one LP per candidate.
-    probe_relax = max(1e-7, floor_slack)
+    # covering, not the fixing margin. The floor stays at 1e-8, BELOW
+    # HiGHS's ~1e-7 primal tolerance, deliberately: raising it to 1e-7
+    # inflates slack_gain ≈ probe_relax·Σm past ALLOWANCE_CAP at n ≈ 1700,
+    # which makes every sound group-probe budget unpassable and degrades
+    # tranche certification to one LP per candidate (measured: ~1001 probe
+    # LPs and +7 s on the sf_e_like stage loop). The rare numerically-empty
+    # face a sub-tolerance relaxation can produce is handled by the
+    # empty-face detection plus the 10×-relaxed retry face below, which
+    # costs one extra LP only when it actually occurs. A loose face (the
+    # old margin+slack relaxation) freed (margin+slack)·Σm ≈ 1e-4-scale
+    # reroutable mass — same failure mode, same lesson.
+    probe_relax = max(1e-8, floor_slack)
     A_eq = np.ones((1, T))
 
     def _bounds_at(relax: float):
@@ -819,6 +823,8 @@ def leximin_cg_typespace(
                     else np.zeros(T, dtype=bool)
                 ) | int_certified
                 for t in np.nonzero(~present & ~excluded & ~int_refuted)[0]:
+                    if present[t]:
+                        continue  # certified by an earlier probe's witness
                     got = oracle.maximize(np.zeros(T), forced_type=int(t))
                     probe_solves += 1
                     if got is None:
@@ -827,8 +833,13 @@ def leximin_cg_typespace(
                             newly_uncoverable.append(int(t))
                     else:
                         add_comp(got[0])
-                        present[t] = True
-                        int_certified[t] = True
+                        # the witness composition certifies EVERY type it
+                        # contains — marking them all cuts the probe count
+                        # ~10× on many-small-type pools (sf_e-like: the
+                        # one-at-a-time loop cost ~7 s of 40 ms MILPs)
+                        witness = got[0] > 0
+                        present |= witness
+                        int_certified |= witness
                 if not newly_uncoverable:
                     break
                 excluded[newly_uncoverable] = True

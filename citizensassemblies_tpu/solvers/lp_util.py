@@ -137,6 +137,20 @@ def probe_confirm_tranche(
                         "relaxation) — certifying nothing."
                     )
             if face_state["empty"]:
+                # a numerically-empty base face (solver-reported z overstates
+                # the true stage optimum by more than the face relaxation)
+                # still admits a sound certificate via the relaxed SUPERSET
+                # face, which contains the true optimal face — without this,
+                # an empty face degrades the whole stage to per-candidate
+                # probes ending in the uncertified dual heuristic
+                if face_max_relaxed is not None:
+                    rv = face_max_relaxed(objectives[i])
+                    if (
+                        rv is not None
+                        and rv != -np.inf
+                        and rv <= z + probe_tol + float(allowances[i])
+                    ):
+                        confirmed[i] = True
                 return
             if face_max_relaxed is not None:
                 rv = face_max_relaxed(objectives[i])
