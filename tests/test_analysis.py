@@ -412,3 +412,17 @@ def test_golden_statistics_example_large_real_data(example_large, tmp_path):
     # its summary lines, matches the golden claim
     lex = result.runs["leximin"].allocation
     assert float(np.abs(lex - 0.1).max()) <= 1e-3
+
+    # demo-parity manifest: the documented verification procedure produces
+    # this file set per instance (reference README.md:149-178 + the upstream
+    # CSV schemas); both example instances now run it end to end in CI
+    for suffix in [
+        "_statistics.txt",
+        "_prob_allocs.pdf",
+        "_prob_allocs_data.csv",
+        "_pair_probability_graph.pdf",
+        "_number_of_unique_panels.pdf",
+        "_ratio_product.pdf",
+        "_ratio_product_data.csv",
+    ]:
+        assert (tmp_path / "analysis" / f"example_large_200{suffix}").exists(), suffix
