@@ -98,3 +98,68 @@ def test_fuzz_xmin_band_and_spread(n, k, ncat, fpc, seed, skew):
     dev = float(np.abs(xm.allocation - xm.fixed_probabilities).max())
     assert dev <= max(cfg.xmin_linf_band, 1e-3) + 1e-9, dev
     assert len(xm.support()) >= len(lex.support())
+
+
+@pytest.mark.parametrize("n,k,ncat,fpc,seed,skew", CASES[:4])
+def test_fuzz_household_quotient_invariants(n, k, ncat, fpc, seed, skew):
+    """Household-quotient fuzz (solvers/quotient.py): random instances with
+    mixed household structures must keep every panel household-disjoint,
+    honor the L∞ contract against the orbit profile, and pass the
+    solver-independent audit evaluated on the augmented instance (where the
+    class-cap MILP bound is tight for the constrained feasible set)."""
+    import dataclasses
+
+    from citizensassemblies_tpu.core.instance import InfeasibleQuotasError
+    from citizensassemblies_tpu.solvers.quotient import build_household_quotient
+
+    inst = skewed_instance(
+        n=n, k=k, n_categories=ncat, features_per_category=fpc,
+        seed=seed, skew=skew,
+    )
+    rng = np.random.default_rng(seed)
+    # mixed structures: ~50% couples, ~10% triples, rest singletons
+    hh = np.arange(n, dtype=np.int32)
+    i = 0
+    while i < n - 2:
+        r = rng.random()
+        if r < 0.5:
+            hh[i + 1] = hh[i]
+            i += 2
+        elif r < 0.6:
+            hh[i + 1] = hh[i + 2] = hh[i]
+            i += 3
+        else:
+            i += 1
+    dense, space = featurize(inst)
+    try:
+        dist = find_distribution_leximin(dense, space, households=hh)
+    except InfeasibleQuotasError as exc:
+        repaired = {
+            cat: {f: exc.quotas[(cat, f)] for f in feats}
+            for cat, feats in inst.categories.items()
+        }
+        inst = dataclasses.replace(inst, categories=repaired)
+        dense, space = featurize(inst)
+        dist = find_distribution_leximin(dense, space, households=hh)
+
+    A = dense.A_np
+    qmin, qmax = dense.qmin_np, dense.qmax_np
+    support = 0
+    for row, p in zip(dist.committees, dist.probabilities):
+        if p <= 1e-11:
+            continue
+        support += 1
+        mem = np.nonzero(row)[0]
+        assert len(mem) == dense.k
+        counts = A[row].sum(axis=0)
+        assert np.all(counts >= qmin) and np.all(counts <= qmax)
+        assert len(set(hh[mem].tolist())) == len(mem), "household collision"
+    assert support >= 1  # the invariant loop must not pass vacuously
+    dev = float(np.abs(dist.allocation - dist.fixed_probabilities).max())
+    assert dev <= 1e-3, f"L∞ dev {dev:.2e} breaks the 1e-3 contract"
+    # no covered agent may sit at structural zero (integer-certified coverage)
+    cov = dist.allocation[dist.covered]
+    assert cov.size == 0 or float(cov.min()) > 1e-9
+    quotient = build_household_quotient(dense, hh)
+    audit = audit_maximin(quotient.dense_aug, dist.allocation, dist.covered)
+    assert audit["maximin_gap"] <= 1.5e-3, audit
