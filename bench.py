@@ -183,10 +183,22 @@ def main() -> None:
                     # highs_backend.audit_maximin).
                     from citizensassemblies_tpu.solvers.highs_backend import (
                         audit_maximin,
+                        audit_second_level,
                     )
 
                     t0 = time.time()
                     audit = audit_maximin(sfe_dense, sfe.allocation, sfe.covered)
+                    # second leximin level, certified independently too
+                    # (Lagrangian-tightened witness — VERDICT r3 #6); never
+                    # let an audit-side failure take down the flagship row
+                    try:
+                        audit.update(
+                            audit_second_level(
+                                sfe_dense, sfe.allocation, sfe.covered
+                            )
+                        )
+                    except Exception as exc:  # pragma: no cover
+                        audit["level2_error"] = f"{type(exc).__name__}: {exc}"[:120]
                     audit["audit_s"] = round(time.time() - t0, 1)
                 detail[key] = {
                     "seconds": round(median_s, 1),

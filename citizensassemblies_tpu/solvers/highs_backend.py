@@ -582,3 +582,131 @@ def audit_maximin(
         "certified_maximin_upper": round(float(upper), 6),
         "maximin_gap": round(float(upper) - z_min, 6),
     }
+
+
+def audit_second_level(
+    dense,
+    allocation: np.ndarray,
+    covered: Optional[np.ndarray] = None,
+    level_tol: float = 1e-3,
+) -> dict:
+    """Solver-independent certificate for the SECOND leximin level.
+
+    ``audit_maximin`` bounds level 1; this bounds level 2 (VERDICT r3 #6's
+    second-level-audit criterion). Let ``S1`` be the covered agents within
+    ``level_tol`` of the achieved minimum. For ANY feasible distribution —
+    in particular any that realizes at least the achieved level-1 values,
+    a constraint this bound validly *relaxes away* — and any probability
+    vector ``w`` over covered agents outside ``S1``,
+
+        second-level min ≤ Σ w_i · alloc_i ≤ max_{feasible committee x} w·x,
+
+    and the right-hand maximum is evaluated by the exact agent-space HiGHS
+    MILP, so the bound holds regardless of where ``w`` came from. The
+    witness is the floor-dual vector of the stage-2 LP over the marginal
+    polytope with S1 pinned at its achieved values (tight when the
+    allocation is exact). Returns achieved/upper/gap for level 2 plus the
+    S1 size; a gap within ~1e-3 certifies the second level independently
+    of the type-space machinery.
+    """
+    from citizensassemblies_tpu.solvers.lp_util import robust_linprog
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+    red = TypeReduction(dense)
+    T, F = red.T, red.F
+    m = red.msize.astype(np.float64)
+    alloc = np.asarray(allocation, dtype=np.float64)
+    if covered is None:
+        covered = np.ones(dense.n, dtype=bool)
+    covered = np.asarray(covered, dtype=bool)
+    cov_t = np.zeros(T, dtype=bool)
+    np.logical_or.at(cov_t, red.type_id, covered)
+    # per-type achieved values (allocations are type-constant up to the
+    # realization tolerance; take the min so floors never overstate)
+    v_t = np.full(T, np.inf)
+    np.minimum.at(v_t, red.type_id, np.where(covered, alloc, np.inf))
+    v_t = np.where(cov_t, v_t, 0.0)
+    lvl1 = float(v_t[cov_t].min()) if cov_t.any() else 0.0
+    s1_t = cov_t & (v_t <= lvl1 + level_tol)
+    lvl2_t = cov_t & ~s1_t
+    if not lvl2_t.any():
+        return {
+            "achieved_level2": None, "certified_level2_upper": None,
+            "level2_gap": 0.0, "level1_set_types": int(s1_t.sum()),
+        }
+    achieved2 = float(v_t[lvl2_t].min())
+
+    tf = np.zeros((T, F))
+    for t in range(T):
+        tf[t, red.type_feature[t]] = 1.0
+    # Per-type COVERED member counts: only covered members carry the level-1
+    # guarantee (uncovered agents sit at structural 0), so both the floor
+    # rows and the Lagrangian subtraction must scale with the covered count,
+    # not the full type size.
+    cnt_t = np.zeros(T)
+    np.add.at(cnt_t, red.type_id, covered.astype(np.float64))
+    # The floor a competing LEVEL-2-OPTIMAL distribution provably honors is
+    # the certified level-1 value — which is ≥ the ACHIEVED minimum lvl1
+    # (our own allocation attains lvl1, so the optimum cannot be lower).
+    # Pinning at the achieved per-type values v_t > lvl1 would assume floors
+    # a competitor need not satisfy and could undercut the true optimum.
+    floor1 = max(lvl1 - 1e-9, 0.0)
+    # stage-2 LP over the marginal polytope: max z s.t. x ∈ X,
+    # x_t ≥ floor1·cnt_t (S1), x_t ≥ z·m_t (level-2 candidates)
+    n2 = int(lvl2_t.sum())
+    idx2 = np.nonzero(lvl2_t)[0]
+    c = np.zeros(T + 1)
+    c[T] = -1.0
+    A_ub = np.zeros((2 * F + n2, T + 1))
+    A_ub[:F, :T] = -tf.T
+    A_ub[F : 2 * F, :T] = tf.T
+    A_ub[2 * F + np.arange(n2), idx2] = -1.0
+    A_ub[2 * F :, T] = m[idx2]
+    b_ub = np.concatenate(
+        [-red.qmin.astype(float), red.qmax.astype(float), np.zeros(n2)]
+    )
+    lo = np.where(s1_t, np.clip(floor1 * cnt_t, 0.0, m), 0.0)
+    res = robust_linprog(
+        c, A_ub=A_ub, b_ub=b_ub,
+        A_eq=np.concatenate([np.ones(T), [0.0]])[None, :],
+        b_eq=[float(red.k)],
+        bounds=[(lo[t], m[t]) for t in range(T)] + [(0, None)],
+    )
+    if res.status != 0:
+        raise SelectionError(f"second-level witness LP failed: {res.message}")
+    y2 = np.maximum(-np.asarray(res.ineqlin.marginals)[2 * F :], 0.0)
+    w_t = np.zeros(T)
+    w_t[idx2] = y2
+    # per-agent weights: y_t per member (the stage dual makes Σ y_t·m_t = 1);
+    # support only covered level-2 agents so the averaging bound stays valid
+    w = np.where(covered, w_t[red.type_id], 0.0)
+    # S1-floor multipliers (the LP's lower-bound duals): for any λ ≥ 0 and
+    # any distribution honoring the level-1 floor a_i ≥ floor1 on covered
+    # S1 members,
+    #   Σ w·a ≤ Σ w·a + Σ_{S1,cov} λ·(a − floor1)
+    #         = E[ (w+λ)·x ] − Σ_t λ_t·floor1·cnt_t
+    #         ≤ max_{feasible x} (w+λ)·x − Σ_t λ_t·floor1·cnt_t,
+    # which is what restores tightness — without λ the MILP may route mass
+    # away from S1 entirely and the bound inflates by ~1e-2 (measured)
+    lam_t = np.zeros(T)
+    if res.lower is not None and res.lower.marginals is not None:
+        lam_t = np.maximum(np.asarray(res.lower.marginals)[:T], 0.0)
+    lam_t = np.where(s1_t, lam_t, 0.0)
+    total = w.sum()
+    if total <= 0:
+        # degenerate dual: uniform witness over covered level-2 agents
+        w = np.where(covered & lvl2_t[red.type_id], 1.0, 0.0)
+        total = w.sum()
+        lam_t[:] = 0.0
+    w = w / total
+    lam_t = lam_t / total
+    u = w + np.where(covered, lam_t[red.type_id], 0.0)
+    oracle = HighsCommitteeOracle(dense)
+    _panel, raw = oracle._milp_maximize(u)
+    upper = float(raw) - float(np.sum(lam_t * floor1 * cnt_t))
+    return {
+        "achieved_level2": round(achieved2, 6),
+        "certified_level2_upper": round(upper, 6),
+        "level2_gap": round(upper - achieved2, 6),
+        "level1_set_types": int(s1_t.sum()),
+    }
