@@ -467,7 +467,7 @@ def _decomp_lp(MT: np.ndarray, v: np.ndarray) -> Tuple[float, np.ndarray, float,
     c_obj[C] = 1.0
     res = robust_linprog(
         c_obj, A_ub=G, b_ub=h, A_eq=A_eq, b_eq=[1.0],
-        bounds=[(0, None)] * (C + 1), methods=("highs-ipm", "highs"),
+        bounds=[(0, None)] * (C + 1), methods=("highs-ds", "highs-ipm", "highs"),
     )
     if res.status != 0:
         raise RuntimeError(f"decomposition LP failed: {res.message}")
@@ -481,6 +481,9 @@ def _slice_relaxation(
     x: np.ndarray,
     reduction: TypeReduction,
     R: int = 512,
+    j0: int = 0,
+    chunks: int = 1,
+    max_passes: Optional[int] = None,
 ) -> List[np.ndarray]:
     """Systematic apportionment of a fractional marginal into ``R`` integer
     compositions whose uniform mixture reproduces ``x`` to within ~1/R.
@@ -493,6 +496,22 @@ def _slice_relaxation(
     (≈5–20 % feasible on tight instances), these columns are *aimed*: their
     hull surrounds ``x`` by construction, which is what the decomposition
     master needs."""
+    from citizensassemblies_tpu.solvers.native_oracle import slice_stream_native
+
+    # one native call for the whole stream when the toolchain is available:
+    # the per-slice path below costs ~0.3 ms/slice of ctypes marshalling and
+    # numpy bookkeeping, which at R ≈ 1000 dominated mid-tier leximin solves.
+    # j0 offsets the tie streams (fresh slices of the same hull on repeated
+    # calls); chunks > 1 runs that many GIL-released streams in parallel.
+    if max_passes is None:
+        max_passes = 3 * reduction.F
+    streamed = slice_stream_native(
+        reduction, np.asarray(x, dtype=np.float64), R,
+        max_passes=max_passes, j0=j0, chunks=chunks,
+    )
+    if streamed is not None:
+        return list(streamed)
+
     T = reduction.T
     k = reduction.k
     lo, hi = reduction.qmin, reduction.qmax
@@ -529,7 +548,7 @@ def _slice_relaxation(
         the slicer's runtime at T ≈ 800.
         """
         tie = np.random.default_rng(j)
-        for _ in range(3 * reduction.F):
+        for _ in range(max_passes):
             track = np.clip(c - need, -2.0, 2.0)
             pref_sub = -0.4 * track  # donate where above target ⇒ lower score
             pref_add = 0.4 * track  # receive where below target ⇒ lower score
@@ -609,32 +628,56 @@ def _slice_relaxation(
     from citizensassemblies_tpu.solvers.native_oracle import repair_slice_native
 
     out: List[np.ndarray] = []
+    # j0 shifts the per-type apportionment phase (see native slice_stream):
+    # repair-free slices are pure functions of the apportionment, so tie
+    # noise alone cannot diversify them between passes
+    phase = (
+        (j0 * 0.38196601125 + tidx * 0.61803398875) % 1.0
+        if j0
+        else np.zeros(T)
+    )
     for j in range(1, R + 1):
-        need = j * x - assigned
+        need = (j + phase) * x - assigned
         c = np.maximum(np.floor(need + 1e-12), 0.0).astype(np.int64)
         c = np.minimum(c, msize)
         gap = k - int(c.sum())
+        counts = c @ tf
         if gap != 0:
             # top up (or trim) by residual fraction; a per-slice golden-ratio
-            # jitter rotates exact ties. Quota overshoot is left to the swap
-            # repair below.
+            # jitter rotates exact ties. Two sweeps, the first quota-aware
+            # (additions below hi / removals above lo only) — quota-blind
+            # top-up left ~10-20 violations for the swap repair, which was
+            # most of the slicer's cost. Mirrors the native stream exactly.
             frac = need - np.floor(need + 1e-12)
-            jitter = ((tidx * 0.6180339887 + j * 0.7548776662) % 1.0) * 1e-6
+            jitter = ((tidx * 0.6180339887 + (j + j0) * 0.7548776662) % 1.0) * 1e-6
             frac = frac + jitter
-            if gap > 0:
-                order = np.argsort(-frac)
-                elig = order[c[order] < msize[order]][:gap]
-                c[elig] += 1
-                gap -= len(elig)
-            else:
-                order = np.argsort(frac)
-                elig = order[c[order] > 0][:-gap]
-                c[elig] -= 1
-                gap += len(elig)
+            order = np.argsort(-frac) if gap > 0 else np.argsort(frac)
+            for sweep in range(2):
+                if gap == 0:
+                    break
+                for t in order:
+                    if gap == 0:
+                        break
+                    feats = feat_of[t]
+                    if gap > 0:
+                        if c[t] >= msize[t]:
+                            continue
+                        if sweep == 0 and np.any(counts[feats] + 1 > hi[feats]):
+                            continue
+                        c[t] += 1
+                        counts[feats] += 1
+                        gap -= 1
+                    else:
+                        if c[t] <= 0:
+                            continue
+                        if sweep == 0 and np.any(counts[feats] - 1 < lo[feats]):
+                            continue
+                        c[t] -= 1
+                        counts[feats] -= 1
+                        gap += 1
         if gap != 0:
             assigned += c  # feed back even on drop, keeping the stream honest
             continue
-        counts = c @ tf
         # the repair loop is the slicer's host hot spot (tens of passes per
         # slice of small-array work): the native C++ implementation runs the
         # identical scoring ~100× faster; the python path remains as the
@@ -642,10 +685,10 @@ def _slice_relaxation(
         c32 = np.ascontiguousarray(c, dtype=np.int32)
         cnt32 = np.ascontiguousarray(counts, dtype=np.int32)
         ok = repair_slice_native(
-            reduction, c32, cnt32, need, seed=j, max_passes=3 * reduction.F
+            reduction, c32, cnt32, need, seed=j + j0, max_passes=max_passes
         )
         if ok is None:
-            ok = swap_repair(c, counts, j, need)
+            ok = swap_repair(c, counts, j + j0, need)
         else:
             c[:] = c32
         assigned += c
@@ -910,6 +953,12 @@ def leximin_cg_typespace(
             # dozens of correction rounds short of the actual target.
             x_target = v_relax * reduction.msize.astype(np.float64)
             injected = 0
+            # R=1024 is the sweet spot for the first master: hd/obf-class
+            # shapes certify on it directly, and when the round-0 master
+            # misses (sf_d-class), the face loop's deep R=2048 pass (fresh
+            # tie streams via j0) supplies the missing hull diversity at the
+            # cost of one more master — cheaper than paying a deep stream
+            # plus a large first master on every instance
             for c in _slice_relaxation(x_target, reduction, R=1024):
                 injected += add_comp(c)
             if T <= 64:

@@ -515,7 +515,13 @@ def realize_profile(
                 _slice_relaxation,
             )
 
-            deep_slices = _slice_relaxation(v * m, reduction, R=2048)
+            # j0 phase-shifts the apportionment relative to the injection
+            # stream (which ran the same target at j0=0): same hull, fresh
+            # rounding boundaries — without the shift this pass would emit
+            # mostly byte-duplicates of the injected slices
+            deep_slices = _slice_relaxation(
+                v * m, reduction, R=2048, j0=1 << 20, chunks=4
+            )
             if deep_slices:
                 cand.append(np.stack(deep_slices).astype(np.int16))
         # exact anchors: best compositions against the dual direction — these

@@ -625,12 +625,21 @@ def audit_leximin_profile(
     λ ≥ 0 on the floored types. This certifies the same thing the
     reference's per-stage Gurobi dual gap certifies (``leximin.py:429-431``):
     each level is optimal GIVEN the prefix already fixed — stage-local
-    optimality, level by level, for the whole profile — with every bound
-    evaluated by an exact MILP entirely outside the type-space machinery.
+    optimality, level by level, for the whole profile. Two valid upper
+    bounds are evaluated per level and both reported: ``milp_upper``, the
+    Lagrangian bound from an exact agent-space HiGHS MILP entirely outside
+    the type-space machinery (fully solver-independent, but carrying an
+    integrality duality gap deep in the profile), and ``marginal_upper``,
+    the witness LP's own optimum (tight everywhere, but it shares the
+    marginal-relaxation viewpoint with the production solver). The
+    headline ``gap`` uses their min — sound, since each is a valid bound —
+    while ``gap_milp``/``worst_gap_milp`` record how far the fully
+    independent certificate alone reaches.
     One witness LP + one MILP per distinct level (~0.15 s each at n=1727).
 
-    Returns ``{"levels": [...], "n_levels", "worst_gap", "all_within_tol"}``
-    where each level entry carries achieved/upper/gap and the level set size.
+    Returns ``{"levels": [...], "n_levels", "worst_gap", "worst_gap_milp",
+    "all_within_tol"}`` where each level entry carries
+    achieved/upper/gap/gap_milp and the level set size.
 
     Pass the CERTIFIED profile (``Distribution.fixed_probabilities``) as
     ``allocation``, not the realized one: flooring the prefix at realized
@@ -674,6 +683,7 @@ def audit_leximin_profile(
     remaining = cov_t.copy()
     levels: list = []
     worst_gap = 0.0
+    worst_gap_milp = 0.0
     while remaining.any() and (max_levels is None or len(levels) < max_levels):
         lvl = float(v_t[remaining].min())
         S = remaining & (v_t <= lvl + level_tol)
@@ -709,7 +719,8 @@ def audit_leximin_profile(
         w_t = np.zeros(T)
         w_t[idxr] = y
         # per-agent weights: y_t per member (the stage dual makes
-        # Σ y_t·m_t ≈ 1); support only covered remaining agents
+        # Σ y_t·cnt_t ≈ 1 — the z column's coefficients are the covered
+        # counts); support only covered remaining agents
         w = np.where(covered, w_t[red.type_id], 0.0)
         lam_t = np.zeros(T)
         if res.lower is not None and res.lower.marginals is not None:
@@ -740,8 +751,13 @@ def audit_leximin_profile(
             return float(raw) - float(np.sum(lam * fixed_floor * cnt_t)), panel
 
         upper_milp, panel = milp_bound(lam_t)
-        lam_best = lam_t
         if fixed_mask.any() and upper_milp > lvl + level_tol:
+            # projected subgradient with backtracking: step from the best λ
+            # found so far; a worsening step reverts (λ AND its argmax
+            # panel, which seeds the next subgradient) and halves the step —
+            # continuing from the worse point spent the remaining MILP calls
+            # exploring a degraded region
+            lam_best, panel_best = lam_t.copy(), panel
             lam = lam_t.copy()
             step = 1.0
             for _ in range(8):
@@ -756,15 +772,18 @@ def audit_leximin_profile(
                 lam = np.maximum(lam - step * g / max(np.abs(g).max(), 1.0) * 0.1, 0.0)
                 val, panel = milp_bound(lam)
                 if val < upper_milp - 1e-12:
-                    upper_milp, lam_best = val, lam
+                    upper_milp, lam_best, panel_best = val, lam.copy(), panel
                 else:
+                    lam, panel = lam_best.copy(), panel_best
                     step *= 0.5
                     if step < 0.05:
                         break
 
         upper = min(upper_milp, marginal_upper)
         gap = upper - lvl
+        gap_milp = upper_milp - lvl
         worst_gap = max(worst_gap, gap)
+        worst_gap_milp = max(worst_gap_milp, gap_milp)
         levels.append(
             {
                 "achieved": round(lvl, 6),
@@ -772,6 +791,7 @@ def audit_leximin_profile(
                 "milp_upper": round(upper_milp, 6),
                 "marginal_upper": round(marginal_upper, 6),
                 "gap": round(gap, 6),
+                "gap_milp": round(gap_milp, 6),
                 "types": int(S.sum()),
             }
         )
@@ -790,6 +810,7 @@ def audit_leximin_profile(
         "levels": levels,
         "n_levels": len(levels),
         "worst_gap": round(worst_gap, 6),
+        "worst_gap_milp": round(worst_gap_milp, 6),
         "all_within_tol": bool(worst_gap <= level_tol),
         "audited_types": int(fixed_mask.sum()),
     }

@@ -205,6 +205,19 @@ def _load_repair() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_double),  # need
                 ctypes.c_uint32, ctypes.c_int,
             ]
+            lib.slice_stream.restype = ctypes.c_int
+            lib.slice_stream.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),  # type_feature
+                ctypes.POINTER(ctypes.c_int32),  # msize
+                ctypes.POINTER(ctypes.c_int32),  # lo
+                ctypes.POINTER(ctypes.c_int32),  # hi
+                ctypes.c_int,  # k
+                ctypes.POINTER(ctypes.c_double),  # x
+                ctypes.c_int, ctypes.c_int,  # R, max_passes
+                ctypes.c_uint32,  # j0 (tie-stream offset)
+                ctypes.POINTER(ctypes.c_int32),  # out [R*T]
+            ]
             _repair_lib = lib
         except Exception:
             _repair_failed = True
@@ -253,6 +266,73 @@ def repair_slice_native(
         ctypes.c_uint32(seed & 0xFFFFFFFF), int(max_passes),
     )
     return bool(ok)
+
+
+def slice_stream_native(
+    reduction: "TypeReduction",
+    x: np.ndarray,
+    R: int,
+    max_passes: int,
+    j0: int = 0,
+    chunks: int = 1,
+) -> Optional[np.ndarray]:
+    """The full aimed-slicer loop in one native call (``slice_stream`` in
+    ``native/slice_repair.cpp``): apportionment, gap top-up, quota repair and
+    cumulative feedback for all ``R`` slices. The per-slice python path costs
+    ~0.3 ms/slice in ctypes marshalling and numpy bookkeeping — at R ≈ 1000
+    that overhead alone dominated mid-tier (n ≈ 300-400) leximin solves.
+
+    ``j0`` shifts the apportionment phase and the tie streams (see
+    ``slice_stream`` in the C++ source), so repeated calls with different
+    offsets emit *different* slices of the same hull. ``chunks > 1`` splits
+    the stream into that many independent full streams of ``R // chunks``
+    slices (offsets spaced by ``1 << 16``) run on a thread pool — ctypes
+    releases the GIL, so the C++ streams run truly in parallel; each chunk's
+    mixture still tracks ``x``, to ~chunks/R instead of ~1/R, which hull
+    seeding cannot tell apart. Deterministic for fixed (R, j0, chunks).
+
+    Returns the kept slices as int32 [kept, T], or None when the native
+    toolchain is unavailable (callers run the per-slice path instead)."""
+    lib = _load_repair()
+    if lib is None:
+        return None
+    T = int(reduction.T)
+    tf = np.ascontiguousarray(reduction.type_feature, dtype=np.int32)
+    msize = np.ascontiguousarray(reduction.msize, dtype=np.int32)
+    lo = np.ascontiguousarray(reduction.qmin, dtype=np.int32)
+    hi = np.ascontiguousarray(reduction.qmax, dtype=np.int32)
+    x64 = np.ascontiguousarray(x, dtype=np.float64)
+
+    def run(r: int, off: int, out: np.ndarray) -> int:
+        return int(
+            lib.slice_stream(
+                T, reduction.n_cats, reduction.F,
+                _ptr(tf, ctypes.c_int32), _ptr(msize, ctypes.c_int32),
+                _ptr(lo, ctypes.c_int32), _ptr(hi, ctypes.c_int32),
+                int(reduction.k), _ptr(x64, ctypes.c_double),
+                int(r), int(max_passes), ctypes.c_uint32(off & 0xFFFFFFFF),
+                _ptr(out, ctypes.c_int32),
+            )
+        )
+
+    chunks = max(1, min(int(chunks), int(R)))
+    if chunks == 1:
+        out = np.empty((int(R), T), dtype=np.int32)
+        kept = run(int(R), int(j0), out)
+        return out[:kept].copy()
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    sizes = [R // chunks + (1 if i < R % chunks else 0) for i in range(chunks)]
+    bufs = [np.empty((r, T), dtype=np.int32) for r in sizes]
+    with ThreadPoolExecutor(max_workers=chunks) as pool:
+        counts = list(
+            pool.map(
+                lambda i: run(sizes[i], int(j0) + i * (1 << 16), bufs[i]),
+                range(chunks),
+            )
+        )
+    return np.concatenate([bufs[i][: counts[i]] for i in range(chunks)], axis=0)
 
 # --- native water-filling slicer (greedy_decompose's host hot loop) ---------
 

@@ -9,6 +9,11 @@
 // overhead, which dominated the slicer at T ≈ 1000 (~250 µs/pass python vs
 // ~2 µs/pass here).
 //
+// slice_stream runs the ENTIRE R-slice loop natively (apportionment, gap
+// top-up, repair, cumulative feedback): the per-slice ctypes round-trip plus
+// numpy bookkeeping cost ~0.3 ms/slice on the python side — at R ≈ 1000 that
+// was the dominant cost of the whole mid-tier leximin solve.
+//
 // Pure C++17, no dependencies; built like bb_price.cpp (g++ -O2 -shared) and
 // loaded via ctypes from solvers/native_oracle.py.
 
@@ -27,6 +32,153 @@ inline uint32_t xs32(uint32_t& s) {
     return s;
 }
 inline double urand(uint32_t& s) { return (xs32(s) >> 8) * (1.0 / 16777216.0); }
+
+// reusable per-call scratch so the stream loop allocates nothing per slice
+struct RepairScratch {
+    std::vector<double> viol, dv_sub_f, dv_add_f, dv_sub, dv_add, pref_sub,
+        pref_add;
+    std::vector<int> donors, receivers;
+    void init(int T, int F) {
+        viol.resize(F);
+        dv_sub_f.resize(F);
+        dv_add_f.resize(F);
+        dv_sub.resize(T);
+        dv_add.resize(T);
+        pref_sub.resize(T);
+        pref_add.resize(T);
+        donors.reserve(T);
+        receivers.reserve(T);
+    }
+};
+
+// Repairs one slice in place. Returns 1 on success (all quotas met), 0 on
+// failure (caller drops the slice).
+int repair_impl(
+    int T, int ncat, int F,
+    const int32_t* type_feature,
+    const int32_t* msize,
+    const int32_t* lo, const int32_t* hi,
+    int32_t* c, int32_t* counts,
+    const double* need,
+    uint32_t seed, int max_passes, RepairScratch& S) {
+    uint32_t rng = seed ? seed : 1u;
+
+    for (int pass = 0; pass < max_passes; ++pass) {
+        // per-feature violation and one-unit removal/addition deltas
+        double total = 0.0;
+        int worst_over = -1, worst_under = -1;
+        double worst_over_v = 0.0, worst_under_v = 0.0;
+        for (int f = 0; f < F; ++f) {
+            double over = std::max(0, counts[f] - hi[f]);
+            double under = std::max(0, lo[f] - counts[f]);
+            S.viol[f] = over + under;
+            total += S.viol[f];
+            double vs = std::max(0, counts[f] - 1 - hi[f]) +
+                        std::max(0, lo[f] - counts[f] + 1);
+            double va = std::max(0, counts[f] + 1 - hi[f]) +
+                        std::max(0, lo[f] - counts[f] - 1);
+            S.dv_sub_f[f] = vs - S.viol[f];
+            S.dv_add_f[f] = va - S.viol[f];
+            if (over > 0 && S.viol[f] > worst_over_v) {
+                worst_over_v = S.viol[f];
+                worst_over = f;
+            }
+            if (under > 0 && S.viol[f] > worst_under_v) {
+                worst_under_v = S.viol[f];
+                worst_under = f;
+            }
+        }
+        if (total == 0.0) return 1;
+
+        // per-type deltas + tracking preference (donate above target,
+        // receive below target — the slice-stream self-correction)
+        for (int t = 0; t < T; ++t) {
+            double s = 0.0, a = 0.0;
+            const int32_t* tf = type_feature + (size_t)t * ncat;
+            for (int ci = 0; ci < ncat; ++ci) {
+                s += S.dv_sub_f[tf[ci]];
+                a += S.dv_add_f[tf[ci]];
+            }
+            S.dv_sub[t] = s;
+            S.dv_add[t] = a;
+            double track = (double)c[t] - need[t];
+            track = std::min(2.0, std::max(-2.0, track));
+            S.pref_sub[t] = -0.4 * track;
+            S.pref_add[t] = 0.4 * track;
+        }
+
+        auto has_feature = [&](int t, int f) {
+            const int32_t* tf = type_feature + (size_t)t * ncat;
+            for (int ci = 0; ci < ncat; ++ci)
+                if (tf[ci] == f) return true;
+            return false;
+        };
+
+        S.donors.clear();
+        S.receivers.clear();
+        for (int t = 0; t < T; ++t) {
+            bool can_d = c[t] > 0 && (worst_over < 0 || has_feature(t, worst_over));
+            bool can_r =
+                c[t] < msize[t] && (worst_under < 0 || has_feature(t, worst_under));
+            if (can_d) S.donors.push_back(t);
+            if (can_r) S.receivers.push_back(t);
+        }
+        if (S.donors.empty() || S.receivers.empty()) return 0;
+
+        // keep the 16 most promising per side (score + tie noise)
+        auto shrink = [&](std::vector<int>& v, const std::vector<double>& dv,
+                          const std::vector<double>& pref) {
+            if ((int)v.size() <= 16) return;
+            std::vector<std::pair<double, int>> scored;
+            scored.reserve(v.size());
+            for (int t : v)
+                scored.emplace_back(dv[t] + pref[t] + urand(rng) * 0.3, t);
+            std::partial_sort(scored.begin(), scored.begin() + 16, scored.end());
+            v.clear();
+            for (int i = 0; i < 16; ++i) v.push_back(scored[i].second);
+        };
+        shrink(S.donors, S.dv_sub, S.pref_sub);
+        shrink(S.receivers, S.dv_add, S.pref_add);
+
+        // exact delta on the small cross product, with the shared-feature
+        // correction (a category where donor and receiver share the feature
+        // is a no-op there)
+        double best = 1e300;
+        double best_delta = 0.0;
+        int bd = -1, br = -1;
+        for (int d : S.donors) {
+            const int32_t* tfd = type_feature + (size_t)d * ncat;
+            for (int r : S.receivers) {
+                if (d == r) continue;
+                const int32_t* tfr = type_feature + (size_t)r * ncat;
+                double delta = S.dv_sub[d] + S.dv_add[r];
+                for (int ci = 0; ci < ncat; ++ci)
+                    if (tfd[ci] == tfr[ci])
+                        delta -= S.dv_sub_f[tfd[ci]] + S.dv_add_f[tfr[ci]];
+                double noisy =
+                    delta + S.pref_sub[d] + S.pref_add[r] + urand(rng) * 0.3;
+                if (noisy < best) {
+                    best = noisy;
+                    best_delta = delta;
+                    bd = d;
+                    br = r;
+                }
+            }
+        }
+        if (bd < 0 || best_delta >= 0.0) return 0;
+        c[bd] -= 1;
+        c[br] += 1;
+        const int32_t* tfd = type_feature + (size_t)bd * ncat;
+        const int32_t* tfr = type_feature + (size_t)br * ncat;
+        for (int ci = 0; ci < ncat; ++ci) {
+            counts[tfd[ci]] -= 1;
+            counts[tfr[ci]] += 1;
+        }
+    }
+    for (int f = 0; f < F; ++f)
+        if (counts[f] < lo[f] || counts[f] > hi[f]) return 0;
+    return 1;
+}
 
 }  // namespace
 
@@ -51,128 +203,150 @@ int slice_repair(
     int32_t* c, int32_t* counts,
     const double* need,
     uint32_t seed, int max_passes) {
-    uint32_t rng = seed ? seed : 1u;
-    std::vector<double> viol(F), dv_sub_f(F), dv_add_f(F);
-    std::vector<double> dv_sub(T), dv_add(T), pref_sub(T), pref_add(T);
-    std::vector<int> donors, receivers;
-    donors.reserve(T);
-    receivers.reserve(T);
+    RepairScratch S;
+    S.init(T, F);
+    return repair_impl(T, ncat, F, type_feature, msize, lo, hi, c, counts,
+                       need, seed, max_passes, S);
+}
 
-    for (int pass = 0; pass < max_passes; ++pass) {
-        // per-feature violation and one-unit removal/addition deltas
-        double total = 0.0;
-        int worst_over = -1, worst_under = -1;
-        double worst_over_v = 0.0, worst_under_v = 0.0;
-        for (int f = 0; f < F; ++f) {
-            double over = std::max(0, counts[f] - hi[f]);
-            double under = std::max(0, lo[f] - counts[f]);
-            viol[f] = over + under;
-            total += viol[f];
-            double vs = std::max(0, counts[f] - 1 - hi[f]) +
-                        std::max(0, lo[f] - counts[f] + 1);
-            double va = std::max(0, counts[f] + 1 - hi[f]) +
-                        std::max(0, lo[f] - counts[f] - 1);
-            dv_sub_f[f] = vs - viol[f];
-            dv_add_f[f] = va - viol[f];
-            if (over > 0 && viol[f] > worst_over_v) {
-                worst_over_v = viol[f];
-                worst_over = f;
-            }
-            if (under > 0 && viol[f] > worst_under_v) {
-                worst_under_v = viol[f];
-                worst_under = f;
-            }
-        }
-        if (total == 0.0) return 1;
-
-        // per-type deltas + tracking preference (donate above target,
-        // receive below target — the slice-stream self-correction)
+// The full aimed-slicer stream (cg_typespace._slice_relaxation's loop body):
+// for j = 1..R, apportion the residual j*x − assigned by cumulative
+// largest-remainder rounding, top up/trim to Σc = k by residual fraction
+// (golden-ratio jitter rotating exact ties), quota-repair, and feed every
+// emitted unit back into `assigned` so the uniform mixture tracks x to ~1/R.
+// Kept (feasible) slices are written to out[kept*T .. ]; returns kept.
+// Matches the python loop's arithmetic exactly; per-slice repair seeds are
+// j + j0, identical to the per-slice native path at j0 = 0.
+//
+// j0 shifts the APPORTIONMENT PHASE as well as the tie streams (top-up
+// jitter, repair RNG): slice j apportions the residual (j + φ_t)·x_t −
+// assigned_t with a PER-TYPE phase φ_t = frac(j0·0.38196601125 +
+// t·0.61803398875) ∈ [0, 1). Slices needing no repair are a pure function of
+// the apportionment, so tie noise alone cannot diversify them — a measured
+// j0-without-phase deep pass emitted ~75 % byte-duplicates of the injection
+// stream, and a single scalar phase still duplicated most slices (it moves
+// boundaries by φ·x_t, negligible for the many small-x types). Per-type
+// phases stagger every type's rounding boundary independently, so calls with
+// different j0 emit genuinely different slices of the same hull, while each
+// call's mixture still tracks x to ~1/R (the telescoping leaves a one-off
+// φ_t·x_t ≤ 1-unit offset per type). j0 = 0 keeps the original arithmetic
+// bit-for-bit. This is also what makes chunked parallel streams productive
+// (each chunk is a full stream at its own phase).
+int slice_stream(
+    int T, int ncat, int F,
+    const int32_t* type_feature,
+    const int32_t* msize,
+    const int32_t* lo, const int32_t* hi,
+    int k, const double* x, int R, int max_passes, uint32_t j0,
+    int32_t* out) {
+    std::vector<double> assigned(T, 0.0), need(T), frac(T);
+    std::vector<int32_t> c(T);
+    std::vector<int32_t> counts(F);
+    std::vector<int> order(T);
+    RepairScratch S;
+    S.init(T, F);
+    int kept = 0;
+    std::vector<double> phase(T, 0.0);
+    if (j0)
+        for (int t = 0; t < T; ++t)
+            phase[t] = std::fmod(
+                (double)j0 * 0.38196601125 + (double)t * 0.61803398875, 1.0);
+    for (int j = 1; j <= R; ++j) {
+        long long sum = 0;
         for (int t = 0; t < T; ++t) {
-            double s = 0.0, a = 0.0;
-            const int32_t* tf = type_feature + (size_t)t * ncat;
-            for (int ci = 0; ci < ncat; ++ci) {
-                s += dv_sub_f[tf[ci]];
-                a += dv_add_f[tf[ci]];
-            }
-            dv_sub[t] = s;
-            dv_add[t] = a;
-            double track = (double)c[t] - need[t];
-            track = std::min(2.0, std::max(-2.0, track));
-            pref_sub[t] = -0.4 * track;
-            pref_add[t] = 0.4 * track;
+            need[t] = ((double)j + phase[t]) * x[t] - assigned[t];
+            double fl = std::floor(need[t] + 1e-12);
+            double cv = std::max(fl, 0.0);
+            double mv = (double)msize[t];
+            if (cv > mv) cv = mv;
+            c[t] = (int32_t)cv;
+            // golden-ratio jitter rotates exact fraction ties across slices
+            frac[t] = (need[t] - fl) +
+                      std::fmod((double)t * 0.6180339887 +
+                                    (double)(j + j0) * 0.7548776662,
+                                1.0) *
+                          1e-6;
+            sum += c[t];
         }
-
-        auto has_feature = [&](int t, int f) {
-            const int32_t* tf = type_feature + (size_t)t * ncat;
-            for (int ci = 0; ci < ncat; ++ci)
-                if (tf[ci] == f) return true;
-            return false;
-        };
-
-        donors.clear();
-        receivers.clear();
+        long long gap = (long long)k - sum;
+        // feature counts of the floor assignment, maintained through the
+        // top-up so it can stay quota-aware
+        std::fill(counts.begin(), counts.end(), 0);
         for (int t = 0; t < T; ++t) {
-            bool can_d = c[t] > 0 && (worst_over < 0 || has_feature(t, worst_over));
-            bool can_r =
-                c[t] < msize[t] && (worst_under < 0 || has_feature(t, worst_under));
-            if (can_d) donors.push_back(t);
-            if (can_r) receivers.push_back(t);
+            if (!c[t]) continue;
+            const int32_t* tf = type_feature + (size_t)t * ncat;
+            for (int ci = 0; ci < ncat; ++ci) counts[tf[ci]] += c[t];
         }
-        if (donors.empty() || receivers.empty()) return 0;
-
-        // keep the 16 most promising per side (score + tie noise)
-        auto shrink = [&](std::vector<int>& v, const std::vector<double>& dv,
-                          const std::vector<double>& pref) {
-            if ((int)v.size() <= 16) return;
-            std::vector<std::pair<double, int>> scored;
-            scored.reserve(v.size());
-            for (int t : v)
-                scored.emplace_back(dv[t] + pref[t] + urand(rng) * 0.3, t);
-            std::partial_sort(scored.begin(), scored.begin() + 16, scored.end());
-            v.clear();
-            for (int i = 0; i < 16; ++i) v.push_back(scored[i].second);
-        };
-        shrink(donors, dv_sub, pref_sub);
-        shrink(receivers, dv_add, pref_add);
-
-        // exact delta on the small cross product, with the shared-feature
-        // correction (a category where donor and receiver share the feature
-        // is a no-op there)
-        double best = 1e300;
-        double best_delta = 0.0;
-        int bd = -1, br = -1;
-        for (int d : donors) {
-            const int32_t* tfd = type_feature + (size_t)d * ncat;
-            for (int r : receivers) {
-                if (d == r) continue;
-                const int32_t* tfr = type_feature + (size_t)r * ncat;
-                double delta = dv_sub[d] + dv_add[r];
-                for (int ci = 0; ci < ncat; ++ci)
-                    if (tfd[ci] == tfr[ci])
-                        delta -= dv_sub_f[tfd[ci]] + dv_add_f[tfr[ci]];
-                double noisy =
-                    delta + pref_sub[d] + pref_add[r] + urand(rng) * 0.3;
-                if (noisy < best) {
-                    best = noisy;
-                    best_delta = delta;
-                    bd = d;
-                    br = r;
+        if (gap != 0) {
+            for (int t = 0; t < T; ++t) order[t] = t;
+            // two sweeps by residual fraction: the first only accepts moves
+            // that keep the moved unit's features inside their quota bounds
+            // (additions below hi / removals above lo), the second takes any
+            // eligible type. Quota-blind top-up was the main source of the
+            // ~10-20 repair passes per slice — most of the stream's cost.
+            if (gap > 0) {
+                std::sort(order.begin(), order.end(),
+                          [&](int a, int b) { return frac[a] > frac[b]; });
+                for (int sweep = 0; sweep < 2 && gap != 0; ++sweep) {
+                    for (int t : order) {
+                        if (gap == 0) break;
+                        if (c[t] >= msize[t]) continue;
+                        const int32_t* tf = type_feature + (size_t)t * ncat;
+                        if (sweep == 0) {
+                            bool safe = true;
+                            for (int ci = 0; ci < ncat; ++ci)
+                                if (counts[tf[ci]] + 1 > hi[tf[ci]]) {
+                                    safe = false;
+                                    break;
+                                }
+                            if (!safe) continue;
+                        }
+                        c[t] += 1;
+                        gap -= 1;
+                        for (int ci = 0; ci < ncat; ++ci) counts[tf[ci]] += 1;
+                    }
+                }
+            } else {
+                std::sort(order.begin(), order.end(),
+                          [&](int a, int b) { return frac[a] < frac[b]; });
+                for (int sweep = 0; sweep < 2 && gap != 0; ++sweep) {
+                    for (int t : order) {
+                        if (gap == 0) break;
+                        if (c[t] <= 0) continue;
+                        const int32_t* tf = type_feature + (size_t)t * ncat;
+                        if (sweep == 0) {
+                            bool safe = true;
+                            for (int ci = 0; ci < ncat; ++ci)
+                                if (counts[tf[ci]] - 1 < lo[tf[ci]]) {
+                                    safe = false;
+                                    break;
+                                }
+                            if (!safe) continue;
+                        }
+                        c[t] -= 1;
+                        gap += 1;
+                        for (int ci = 0; ci < ncat; ++ci) counts[tf[ci]] -= 1;
+                    }
                 }
             }
         }
-        if (bd < 0 || best_delta >= 0.0) return 0;
-        c[bd] -= 1;
-        c[br] += 1;
-        const int32_t* tfd = type_feature + (size_t)bd * ncat;
-        const int32_t* tfr = type_feature + (size_t)br * ncat;
-        for (int ci = 0; ci < ncat; ++ci) {
-            counts[tfd[ci]] -= 1;
-            counts[tfr[ci]] += 1;
+        if (gap != 0) {  // un-toppable slice: feed back and drop
+            for (int t = 0; t < T; ++t) assigned[t] += (double)c[t];
+            continue;
+        }
+        int ok = repair_impl(T, ncat, F, type_feature, msize, lo, hi, c.data(),
+                             counts.data(), need.data(), (uint32_t)j + j0,
+                             max_passes, S);
+        // feedback includes repaired units even when the repair failed —
+        // the stream stays honest about what was actually emitted
+        for (int t = 0; t < T; ++t) assigned[t] += (double)c[t];
+        if (ok) {
+            std::memcpy(out + (size_t)kept * T, c.data(),
+                        (size_t)T * sizeof(int32_t));
+            ++kept;
         }
     }
-    for (int f = 0; f < F; ++f)
-        if (counts[f] < lo[f] || counts[f] > hi[f]) return 0;
-    return 1;
+    return kept;
 }
 
 }  // extern "C"

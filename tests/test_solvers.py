@@ -239,6 +239,49 @@ def test_native_slice_repair_matches_python_fallback(monkeypatch):
     native_n = check(_slice_relaxation(x, red, R=128))
     if native_oracle._load_repair() is None:
         pytest.skip("native toolchain unavailable — python path already covered")
+
+    # the batched native stream and the per-slice native path run the same
+    # arithmetic (apportionment, top-up ordering, repair seeds), so their
+    # outputs must be identical slice-for-slice
+    streamed = native_oracle.slice_stream_native(red, x, R=128, max_passes=3 * red.F)
+
+    # the chunked production configuration (face_decompose uses j0=1<<20,
+    # chunks=4): output must be quota-feasible, deterministic, exactly the
+    # concatenation of the per-chunk single streams at the spaced offsets,
+    # and the j0 phase shift must yield mostly-fresh slices vs the j0=0 run
+    j0 = 1 << 20
+    chunked = native_oracle.slice_stream_native(
+        red, x, R=128, max_passes=3 * red.F, j0=j0, chunks=4
+    )
+    check(list(chunked))
+    manual = np.concatenate(
+        [
+            native_oracle.slice_stream_native(
+                red, x, R=32, max_passes=3 * red.F, j0=j0 + i * (1 << 16)
+            )
+            for i in range(4)
+        ],
+        axis=0,
+    )
+    assert np.array_equal(chunked, manual)
+    # what the face master consumes is UNIQUE columns (its add() dedups), so
+    # the phase shift is measured on hull growth: the offset stream must
+    # contribute a substantial set of unique columns the base stream lacks.
+    # (Within-stream repetition is inherent — an apportionment stream cycles
+    # once R exceeds the pattern period — so a raw fresh-slice ratio would
+    # mismeasure diversity.)
+    base_u = {c.astype(np.int32).tobytes() for c in streamed}
+    chunk_u = {c.astype(np.int32).tobytes() for c in chunked}
+    grown = len(chunk_u - base_u)
+    assert grown >= max(8, 0.2 * len(base_u)), (
+        f"phase-shifted stream grew the unique-column hull by only {grown} "
+        f"over {len(base_u)} base uniques"
+    )
+
+    monkeypatch.setattr(native_oracle, "slice_stream_native", lambda *a, **k: None)
+    per_slice = _slice_relaxation(x, red, R=128)
+    assert np.array_equal(np.stack(per_slice), streamed)
+
     # force the python fallback on the same stream
     # cg_typespace imports repair_slice_native function-locally at call
     # time, so patching the native_oracle module attribute is sufficient
