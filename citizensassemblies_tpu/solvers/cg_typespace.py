@@ -465,9 +465,17 @@ def _decomp_lp(MT: np.ndarray, v: np.ndarray) -> Tuple[float, np.ndarray, float,
     A_eq = scipy.sparse.csr_matrix(np.concatenate([np.ones(C), [0.0]])[None, :])
     c_obj = np.zeros(C + 1)
     c_obj[C] = 1.0
+    # dual simplex wins on the small host masters (~25 % over IPM at
+    # T ≈ 150, C ≈ 2000) but degrades badly on tall systems — a T = 1199
+    # polish took ~100 s via ds vs ~10 s via IPM — so the order flips on T
+    methods = (
+        ("highs-ds", "highs-ipm", "highs")
+        if T <= 384
+        else ("highs-ipm", "highs")
+    )
     res = robust_linprog(
         c_obj, A_ub=G, b_ub=h, A_eq=A_eq, b_eq=[1.0],
-        bounds=[(0, None)] * (C + 1), methods=("highs-ds", "highs-ipm", "highs"),
+        bounds=[(0, None)] * (C + 1), methods=methods,
     )
     if res.status != 0:
         raise RuntimeError(f"decomposition LP failed: {res.message}")
@@ -961,6 +969,14 @@ def leximin_cg_typespace(
             # plus a large first master on every instance
             for c in _slice_relaxation(x_target, reduction, R=1024):
                 injected += add_comp(c)
+            # NOTE (measured): topping the hull up with extra phase-shifted
+            # streams when injected < T (household-quotient instances start
+            # under-determined, ε ~ 2e-2) lowers the round-0 ε but does NOT
+            # reduce the face-round count — n=1200 couples ran 187 s with
+            # the top-up vs 170 s without — so the injection stays single-
+            # stream; the ε tail there is integrality structure, not hull
+            # bulk (same finding as the large-T deep-pass experiment in
+            # face_decompose.py).
             if T <= 64:
                 # independent roundings only help at small type counts — at
                 # sf_e scale their quota-feasible yield is zero (measured)

@@ -507,10 +507,16 @@ def realize_profile(
             and eps <= 6 * accept
         ):
             # small-T near-miss after the first master: a deeper aimed-slice
-            # pass (finer apportionment of the same target, new tie-break
+            # pass (finer apportionment of the same target, phase-shifted
             # streams) closes the hull in one host round where generic
             # neighbors needed a 6k-column expansion (sf_d-class: R=2048
-            # slices certify at ε 4.4e-4 vs 1.1e-3 from the 1024 injection)
+            # slices certify at ε 4.4e-4 vs 1.1e-3 from the 1024 injection).
+            # Measured NOT to help large-T device-master instances: adding
+            # phase-shifted streams there (rounds 0-2) left the per-round ε
+            # trajectory unchanged while growing masters and stream cost —
+            # sf_e mild-skew went 47-68 s → 71-89 s — so the gate stays
+            # small-T; the large-T ε tail is integrality structure the
+            # neighbor/anchor expansion addresses, not missing hull bulk.
             from citizensassemblies_tpu.solvers.cg_typespace import (
                 _slice_relaxation,
             )
