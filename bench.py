@@ -200,6 +200,10 @@ def main() -> None:
                         )
                         audit["profile_levels"] = prof["n_levels"]
                         audit["profile_worst_gap"] = prof["worst_gap"]
+                        # MILP-only bound (no marginal-LP rescue): records
+                        # per run that the certificate is independent of the
+                        # type-space machinery, not just that it is small
+                        audit["profile_worst_gap_milp"] = prof["worst_gap_milp"]
                         audit["profile_all_within_tol"] = prof["all_within_tol"]
                         if prof["n_levels"] >= 2:
                             audit["level2_gap"] = prof["levels"][1]["gap"]

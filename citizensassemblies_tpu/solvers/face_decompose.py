@@ -67,6 +67,11 @@ def neighbor_columns(
     comps: np.ndarray,
     reduction: TypeReduction,
     r_norm: np.ndarray,
+    # measured at 2× and 3× these widths on the two large-T regimes
+    # (sf_e mild-skew T=565, household quotient T=1199): round count drops
+    # ~linearly (7→4, 19→10) but per-round master cost rises to match —
+    # wall-clock within noise either way, so the defaults stay at the
+    # smaller, lower-variance setting
     pool_cap: int = 128,
     face_pairs: int = 12_288,
     per_round_cap: int = 16_384,
