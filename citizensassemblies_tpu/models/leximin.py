@@ -294,25 +294,28 @@ def _typespace_leximin(
                 # reference's own EPS=5e-4 final-LP tolerance — chasing
                 # 1e-9 cost ~30 extra host LPs for precision nothing
                 # downstream can see); the CG path floors the panel
-                # tolerance at 2e-5 (its greedy noise scale). On LARGE CG
-                # instances (n ≥ 200, where each polish LP costs ~1 s and
-                # a nexus-class shape needed ~18 of them) the tolerance
+                # tolerance at 2e-5 (its greedy noise scale). On LARGE
+                # instances (n ≥ 200) — on EITHER path — the tolerance
                 # never drops below 2.5e-4 just because the mixture's own ε
-                # is tiny — precision the 1e-3 contract cannot see; small
-                # instances keep the tight bound (the polish is ~0.1 s
-                # there). Otherwise budget against the mixture ε: total
-                # contract error |alloc − v| ≤ tol_panel + eps_dev ≤
-                # accept_band + 1e-4 (= 9e-4 < 1e-3 at the default config;
-                # derived from cfg so the knobs cannot silently drift past
-                # the contract). The n ≥ 200 gate keeps reference-scale
-                # pools (hd_30's n=239 upward) out of the polish loop while
-                # the small test instances stay at the tight bound.
+                # is tiny: precision the 1e-3 contract cannot see. A
+                # nexus-class CG polish paid ~18 LPs at ~1 s for it, and an
+                # enumerated n=469/k=90 single-category instance was worse
+                # still — the greedy seed's panel budget scales with
+                # 1/delta_cap = 1/(1.5·tol), so tol = 1e-6 built a ~6000-
+                # panel portfolio whose ~940×6000 polish LPs took ~20 s
+                # each while shaving ε ~5 %/round: a many-minute stall on
+                # a sub-second instance. Small instances keep the tight
+                # bound (the polish is ~0.1 s there). Otherwise budget
+                # against the mixture ε: total contract error |alloc − v| ≤
+                # tol_panel + eps_dev ≤ accept_band + 1e-4 (= 9e-4 < 1e-3
+                # at the default config; derived from cfg so the knobs
+                # cannot silently drift past the contract).
                 tol=max(
                     1e-6 if comps is not None else 2e-5,
                     min(
                         max(
                             0.5 * getattr(ts, "eps_dev", 0.0),
-                            2.5e-4 if comps is None and dense.n >= 200 else 0.0,
+                            2.5e-4 if dense.n >= 200 else 0.0,
                         ),
                         max(cfg.decomp_accept, cfg.decomp_accept_stalled)
                         + 1e-4

@@ -193,3 +193,30 @@ def test_uncoverable_agent_prefixed_zero_agent_space():
     assert dist.fixed_probabilities[0] == 0.0
     # the coverable agents share the leximin value 3/11
     np.testing.assert_allclose(dist.allocation[1:], 3.0 / 11.0, atol=1e-4)
+
+
+def test_enumerated_large_n_polish_terminates_quickly():
+    """Regression (broad fuzz, round 4): an enumerated-path instance with
+    large n (single category, 4 features, n=469, k=90, heavy skew) built a
+    ~6000-panel greedy portfolio and ground ~20 s polish LPs toward a 1e-6
+    panel tolerance the 1e-3 contract cannot see — a many-minute stall on a
+    sub-second instance. The n >= 200 tolerance floor now applies to the
+    enumerated path too; this shape must solve in seconds with the contract
+    intact."""
+    import time
+
+    from citizensassemblies_tpu.core.generator import skewed_instance
+
+    inst = skewed_instance(
+        n=469, k=90, n_categories=1, seed=204242,
+        features_per_category=[4], skew=0.85,
+    )
+    dense, space = featurize(inst)
+    t0 = time.time()
+    dist = find_distribution_leximin(dense, space)
+    elapsed = time.time() - t0
+    dev = float(np.abs(dist.allocation - dist.fixed_probabilities).max())
+    assert dev <= 1e-3
+    # pre-fix this ran for many minutes; allow generous headroom over the
+    # measured 0.2 s so CI noise cannot flake the regression signal
+    assert elapsed < 60.0, f"enumerated polish took {elapsed:.1f}s"
