@@ -75,26 +75,40 @@ def find_distribution_xmin(
     # (cfg.xmin_iterations_factor·n distinct panels — see config.py for why
     # that exceeds the reference's literal 5n iteration count)
     max_draws = int(cfg.xmin_dedup_attempts_factor * n * target_new)
-    seen = {tuple(np.nonzero(row)[0].tolist()) for row in leximin.committees}
+    # dedup keys are the raw bytes of the sorted member rows: at sf_e scale
+    # the expansion handles ~14k panels of k=110 members, where building a
+    # 110-int Python tuple per panel dominated the host side of this loop
+    seen = {
+        np.sort(np.nonzero(row)[0]).astype(np.int32).tobytes()
+        for row in leximin.committees
+    }
     new_rows: List[np.ndarray] = []
     key = jax.random.PRNGKey(cfg.solver_seed + 1)
     drawn = 0
     while len(new_rows) < target_new and drawn < max_draws:
         B = min(cfg.pricing_batch, max_draws - drawn)
         key, sub = jax.random.split(key)
-        panels, ok = sample_panels_batch(dense, sub, B, households=households)
-        panels = np.sort(np.asarray(panels), axis=1)
-        ok = np.asarray(ok)
+        with log.timer("xmin_draws"):
+            panels, ok = sample_panels_batch(dense, sub, B, households=households)
+            panels = np.sort(np.asarray(panels), axis=1).astype(np.int32)
+            ok = np.asarray(ok)
         drawn += B
-        for b in np.nonzero(ok)[0]:
-            tup = tuple(panels[b].tolist())
-            if tup not in seen:
-                seen.add(tup)
-                row = np.zeros(n, dtype=bool)
-                row[list(tup)] = True
-                new_rows.append(row)
-                if len(new_rows) >= target_new:
-                    break
+        with log.timer("xmin_dedup"):
+            # in-batch dedup vectorized; cross-batch via the bytes set.
+            # Iterate in FIRST-DRAWN order (np.unique returns rows sorted
+            # lexicographically — truncating that order at target_new would
+            # bias the final batch toward low-index agents)
+            ok_panels = panels[ok]
+            _, first = np.unique(ok_panels, axis=0, return_index=True)
+            for prow in ok_panels[np.sort(first)]:
+                kb = prow.tobytes()
+                if kb not in seen:
+                    seen.add(kb)
+                    row = np.zeros(n, dtype=bool)
+                    row[prow] = True
+                    new_rows.append(row)
+                    if len(new_rows) >= target_new:
+                        break
     if new_rows:
         P = np.concatenate([leximin.committees, np.stack(new_rows)], axis=0)
     else:
@@ -105,9 +119,10 @@ def find_distribution_xmin(
     )
 
     # 3) min-L2 redistribution over the grown portfolio (xmin.py:447-455)
-    probs, eps_dev = solve_final_primal_l2(
-        P, leximin.fixed_probabilities, iters=cfg.xmin_qp_iters
-    )
+    with log.timer("xmin_l2"):
+        probs, eps_dev = solve_final_primal_l2(
+            P, leximin.fixed_probabilities, iters=cfg.xmin_qp_iters, log=log
+        )
     probs = np.clip(probs, 0.0, 1.0)
     probs = probs / probs.sum()
     allocation = P.T.astype(np.float64) @ probs

@@ -67,12 +67,17 @@ def solve_final_primal_l2(
     target: np.ndarray,
     iters: int = 20_000,
     eps_margin: float = 1e-6,
+    log=None,
 ) -> Tuple[np.ndarray, float]:
     """Committee probabilities realizing ``target`` within the minimal ε, with
-    minimal L2 norm (maximal spread). Returns (p, ε)."""
+    minimal L2 norm (maximal spread). Returns (p, ε). ``log`` (a ``RunLog``)
+    splits the host ε-LP from the device ascent in the phase timers."""
     from citizensassemblies_tpu.solvers.highs_backend import solve_final_primal_lp
+    from citizensassemblies_tpu.utils.logging import RunLog
 
-    p_lp, eps_star = solve_final_primal_lp(P, target)
+    log = log or RunLog(echo=False)
+    with log.timer("l2_eps_lp"):
+        p_lp, eps_star = solve_final_primal_lp(P, target)
     eps = eps_star + eps_margin
 
     Pj = jnp.asarray(P, dtype=jnp.float32)
@@ -86,8 +91,13 @@ def solve_final_primal_l2(
 
     sigma_sq = float(_power_norm(Pj)) ** 2
     L = max(sigma_sq / 2.0, 1.0)
-    p = _min_norm_dual_ascent(Pj, tj, jnp.float32(eps), jnp.float32(1.0 / L), iters)
-    p = np.asarray(p, dtype=np.float64)
+    with log.timer("l2_dual_ascent"):
+        p = _min_norm_dual_ascent(
+            Pj, tj, jnp.float32(eps), jnp.float32(1.0 / L), iters
+        )
+        # host materialization inside the timer: through a TPU tunnel,
+        # block_until_ready alone does not drain the pipeline (see bench.py)
+        p = np.asarray(p, dtype=np.float64)
     p = np.clip(p, 0.0, 1.0)
     s = p.sum()
     if s <= 0:
