@@ -42,6 +42,32 @@ def _example_small_like():
     return example_small_like_instance()
 
 
+def _attach_profile_audit(audit: dict, dense, probs, covered) -> None:
+    """Run ``audit_leximin_profile`` and fold its headline fields into an
+    existing ``audit_maximin`` dict — shared by the flagship and household
+    rows so the recorded field set cannot drift between them. Audit-side
+    failures never take down a bench row."""
+    from citizensassemblies_tpu.solvers.highs_backend import audit_leximin_profile
+
+    import time as _t
+
+    t0 = _t.time()
+    try:
+        prof = audit_leximin_profile(dense, probs, covered)
+        audit["profile_levels"] = prof["n_levels"]
+        audit["profile_worst_gap"] = prof["worst_gap"]
+        # MILP-only bound (no marginal-LP rescue): records per run that the
+        # certificate is independent of the type-space machinery, not just
+        # that it is small
+        audit["profile_worst_gap_milp"] = prof["worst_gap_milp"]
+        audit["profile_all_within_tol"] = prof["all_within_tol"]
+        if prof["n_levels"] >= 2:
+            audit["level2_gap"] = prof["levels"][1]["gap"]
+    except Exception as exc:  # pragma: no cover
+        audit["profile_error"] = f"{type(exc).__name__}: {exc}"[:120]
+    audit["audit_s"] = round(_t.time() - t0, 1)
+
+
 BASELINES = {
     # reference golden median LEXIMIN runtimes (BASELINE.md)
     "example_large_200_like": 1161.8,
@@ -158,16 +184,37 @@ def main() -> None:
 
         reps = int(os.environ.get("BENCH_REPS", "3"))
         flagship = None
-        for name, builder, seeds in (
-            ("sf_e_skewed", sf_e_skewed_instance, (1, 0)),
-            ("sf_e_like", sf_e_like_instance, (0,)),
-        ):
-            for seed in seeds:
-                sfe_dense, sfe_space = featurize(builder(seed=seed))
+        # the flagship SEED FAMILY (VERDICT r4 #1): the north-star claim must
+        # hold across sf_e-CLASS instances, not the seed the decomposition
+        # likes — four seeds plus two structural variants (tighter quota
+        # bands; more distinct agent types), every one median-of-reps with
+        # per-rep phase splits. sf_e_like is the easy near-proportional
+        # secondary regime (one rep).
+        family = [
+            ("sf_e_skewed", lambda: sf_e_skewed_instance(seed=1), "sf_e_skewed_110", reps),
+            ("sf_e_skewed_seed0", lambda: sf_e_skewed_instance(seed=0), "sf_e_skewed_110", reps),
+            ("sf_e_skewed_seed2", lambda: sf_e_skewed_instance(seed=2), "sf_e_skewed_110", reps),
+            ("sf_e_skewed_seed5", lambda: sf_e_skewed_instance(seed=5), "sf_e_skewed_110", reps),
+            (
+                "sf_e_skewed_tight",
+                lambda: sf_e_skewed_instance(seed=3, quota_slack=0.08),
+                "sf_e_skewed_110",
+                reps,
+            ),
+            (
+                "sf_e_skewed_types",
+                lambda: sf_e_skewed_instance(
+                    seed=2, features_per_category=[3, 4, 6, 3, 2, 4, 6]
+                ),
+                "sf_e_skewed_110",
+                reps,
+            ),
+            ("sf_e_like", lambda: sf_e_like_instance(seed=0), "sf_e_like_110", 1),
+        ]
+        for key, builder, base_key, n_reps in family:
+                sfe_dense, sfe_space = featurize(builder())
                 runs = []
-                # median-of-reps for the flagship regime; the easy
-                # near-uniform secondary row gets one timed run
-                for _ in range(reps if name == "sf_e_skewed" else 1):
+                for _ in range(n_reps):
                     rlog = RunLog(echo=False)
                     t0 = time.time()
                     sfe = find_distribution_leximin(sfe_dense, sfe_space, log=rlog)
@@ -181,8 +228,6 @@ def main() -> None:
                 sfe_stats = prob_allocation_stats(
                     sfe.allocation, cap_for_geometric_mean=False
                 )
-                base_key = f"{name}_110"
-                key = name if seed == seeds[0] else f"{name}_seed{seed}"
                 if key == "sf_e_skewed":
                     # keep the flagship solve for reuse by the XMIN row —
                     # solving n=1727 an extra time there risked pushing the
@@ -198,34 +243,18 @@ def main() -> None:
                     # entirely outside the type-space machinery (see
                     # highs_backend.audit_maximin).
                     from citizensassemblies_tpu.solvers.highs_backend import (
-                        audit_leximin_profile,
                         audit_maximin,
                     )
 
-                    t0 = time.time()
                     # level 1 on the REALIZED allocation (the honest shipped
                     # number); the full profile on the CERTIFIED one — its
                     # documented contract, since realized floors leak the
                     # realization ε into later levels — with the
                     # realized-vs-certified gap reported as alloc_linf_dev.
-                    # Never let an audit-side failure take down the row.
                     audit = audit_maximin(sfe_dense, sfe.allocation, sfe.covered)
-                    try:
-                        prof = audit_leximin_profile(
-                            sfe_dense, sfe.fixed_probabilities, sfe.covered
-                        )
-                        audit["profile_levels"] = prof["n_levels"]
-                        audit["profile_worst_gap"] = prof["worst_gap"]
-                        # MILP-only bound (no marginal-LP rescue): records
-                        # per run that the certificate is independent of the
-                        # type-space machinery, not just that it is small
-                        audit["profile_worst_gap_milp"] = prof["worst_gap_milp"]
-                        audit["profile_all_within_tol"] = prof["all_within_tol"]
-                        if prof["n_levels"] >= 2:
-                            audit["level2_gap"] = prof["levels"][1]["gap"]
-                    except Exception as exc:  # pragma: no cover
-                        audit["profile_error"] = f"{type(exc).__name__}: {exc}"[:120]
-                    audit["audit_s"] = round(time.time() - t0, 1)
+                    _attach_profile_audit(
+                        audit, sfe_dense, sfe.fixed_probabilities, sfe.covered
+                    )
                 detail[key] = {
                     "seconds": round(median_s, 1),
                     "runs_s": [round(t, 1) for t in times],
@@ -260,24 +289,38 @@ def main() -> None:
         from citizensassemblies_tpu.core.generator import (
             cca_skewed_instance,
             hd_skewed_instance,
+            mass_like_instance,
             nexus_skewed_instance,
             obf_skewed_instance,
+            sf_a_skewed_instance,
+            sf_b_skewed_instance,
+            sf_c_skewed_instance,
             sf_d_skewed_instance,
             sf_e_skewed_instance,
         )
 
-        # regime sweep (VERDICT r2 item #6): the remaining baseline shapes —
-        # cca_75 (n=825, 4 cats, strongly heterogeneous), obf_30 (n=321,
-        # 8 cats), nexus_170 (n=342, k=170: the high-selection-ratio
-        # regime), and the mid-tier hd_30 (n=239, 7 cats) and sf_d_40
-        # (n=404, 6 cats). Real pools withheld; baselines are the reference
-        # timings on the real instances, marked estimated.
+        # regime sweep: ALL remaining baseline shapes, completing the
+        # reference's 12-instance table (VERDICT r4 #5) — cca_75 (n=825,
+        # 4 cats, strongly heterogeneous), obf_30 (n=321, 8 cats), nexus_170
+        # (n=342, k=170: the high-selection-ratio regime), the mid-tier
+        # hd_30/sf_d_40, the small sf_a/sf_b/sf_c shapes, and mass_24's
+        # tight min=max regime. Real pools withheld; baselines are the
+        # reference timings on the real instances, marked estimated. NOTE on
+        # the sub-second baselines (mass_24 at 0.5 s especially): our
+        # per-run floor is a few hundred ms of host/dispatch overhead, so a
+        # ≥50× speedup is arithmetically impossible there — those rows
+        # demonstrate coverage (the tight-quota regime solving correctly at
+        # speed), not the headline ratio.
         for name, builder, base in (
             ("cca_skewed_75", cca_skewed_instance, 433.5),
             ("obf_skewed_30", obf_skewed_instance, 183.9),
             ("nexus_skewed_170", nexus_skewed_instance, 83.4),
             ("hd_skewed_30", hd_skewed_instance, 37.2),
             ("sf_d_skewed_40", sf_d_skewed_instance, 46.2),
+            ("sf_a_skewed_35", sf_a_skewed_instance, 19.6),
+            ("sf_b_skewed_20", sf_b_skewed_instance, 8.8),
+            ("sf_c_skewed_44", sf_c_skewed_instance, 6.0),
+            ("mass_like_24", mass_like_instance, 0.5),
         ):
             d2, s2 = featurize(builder())
             # median of 3: these rows are seconds each, and a single-sample
@@ -365,9 +408,6 @@ def main() -> None:
         from citizensassemblies_tpu.solvers.quotient import build_household_quotient
 
         def _run_households(tag, inst_h, households):
-            from citizensassemblies_tpu.solvers.highs_backend import (
-                audit_leximin_profile,
-            )
             from citizensassemblies_tpu.utils.logging import RunLog
 
             hh_dense, hh_space = featurize(inst_h)
@@ -414,18 +454,9 @@ def main() -> None:
             # per-stage Gurobi dual gap plays on its household runs too
             # (leximin.py:211-221,429-431).
             audit = audit_maximin(quotient.dense_aug, hh.allocation, hh.covered)
-            t_aud = time.time()
-            try:
-                prof = audit_leximin_profile(
-                    quotient.dense_aug, hh.fixed_probabilities, hh.covered
-                )
-                audit["profile_levels"] = prof["n_levels"]
-                audit["profile_worst_gap"] = prof["worst_gap"]
-                audit["profile_worst_gap_milp"] = prof["worst_gap_milp"]
-                audit["profile_all_within_tol"] = prof["all_within_tol"]
-            except Exception as exc:  # pragma: no cover
-                audit["profile_error"] = f"{type(exc).__name__}: {exc}"[:120]
-            audit["audit_s"] = round(time.time() - t_aud, 1)
+            _attach_profile_audit(
+                audit, quotient.dense_aug, hh.fixed_probabilities, hh.covered
+            )
             detail[tag] = {
                 "seconds": round(el_h, 1),
                 "alloc_linf_dev": round(

@@ -15,7 +15,7 @@ import csv
 import itertools
 import math
 from pathlib import Path
-from typing import Dict, List, Sequence, Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -290,7 +290,12 @@ def nexus_skewed_instance(seed: int = 1) -> Instance:
     )
 
 
-def sf_e_skewed_instance(seed: int = 1) -> Instance:
+def sf_e_skewed_instance(
+    seed: int = 1,
+    quota_slack: float = 0.12,
+    skew: float = 0.4,
+    features_per_category: Optional[Sequence[int]] = None,
+) -> Instance:
     """Heterogeneous synthetic stand-in for the withheld ``sf_e_110`` pool in
     its *realistic* allocation regime.
 
@@ -301,16 +306,96 @@ def sf_e_skewed_instance(seed: int = 1) -> Instance:
     Gini 51.2 %, min 2.6 % vs k/n 6.4 %, lines 6-11) — unlike
     :func:`sf_e_like_instance`, whose pool-proportional quotas make leximin
     collapse to the uniform k/n. Other seeds vary the profile (seed 0 lands
-    at Gini ≈ 0.27, a milder but still heterogeneous regime).
+    at Gini ≈ 0.27, a milder but still heterogeneous regime). The keyword
+    knobs span the bench's flagship SEED FAMILY (VERDICT r4 #1): tighter
+    ``quota_slack`` narrows every quota band, a different ``skew`` shifts
+    the heterogeneity, and ``features_per_category`` varies the distinct
+    type count the solvers face.
     """
     return skewed_instance(
         n=1727,
         k=110,
         n_categories=7,
-        features_per_category=[2, 4, 5, 3, 2, 4, 6],
+        features_per_category=list(features_per_category or [2, 4, 5, 3, 2, 4, 6]),
         seed=seed,
-        skew=0.4,
+        quota_slack=quota_slack,
+        skew=skew,
         name="sf_e_skewed_110",
+    )
+
+
+def mass_like_instance(seed: int = 3) -> Instance:
+    """A mass_24-shaped instance: n=70, k=24, 5 categories, with two
+    categories fully pinned (min = max on every cell) — the degenerate/tight
+    regime SURVEY §7 flags as a top risk (the real mass pool is withheld;
+    shape from ``reference_output/mass_24_statistics.txt:2-4``, baseline
+    runtime 0.5 s at line 15)."""
+    import dataclasses
+
+    base = random_instance(
+        n=70, k=24, n_categories=5, features_per_category=[2, 3, 2, 3, 2],
+        seed=seed, name="mass_like_24",
+    )
+    cats: Dict[str, Dict[str, Quota]] = {}
+    for ci, (cat, feats) in enumerate(base.categories.items()):
+        names = list(feats)
+        counts = np.array(
+            [sum(1 for a in base.agents if a[cat] == f) for f in names], float
+        )
+        if ci < 2:
+            # pin to the proportional integer composition: min = max
+            exact = np.floor(counts / 70.0 * 24.0).astype(int)
+            order = np.argsort(-(counts / 70.0 * 24.0 - exact))
+            for j in order[: 24 - exact.sum()]:
+                exact[j] += 1
+            cats[cat] = {f: (int(c), int(c)) for f, c in zip(names, exact)}
+        else:
+            cats[cat] = feats
+    return dataclasses.replace(base, categories=cats)
+
+
+def sf_a_skewed_instance(seed: int = 1) -> Instance:
+    """Heterogeneous synthetic stand-in shaped like ``sf_a_35`` (n=312, k=35,
+    6 categories, LEXIMIN Gini 37.3 % / min 6.7 % / runtime 19.6 s,
+    ``reference_output/sf_a_35_statistics.txt:2-5,9,15``)."""
+    return skewed_instance(
+        n=312,
+        k=35,
+        n_categories=6,
+        features_per_category=[2, 3, 4, 2, 3, 3],
+        seed=seed,
+        skew=0.6,
+        name="sf_a_skewed_35",
+    )
+
+
+def sf_b_skewed_instance(seed: int = 1) -> Instance:
+    """Heterogeneous synthetic stand-in shaped like ``sf_b_20`` (n=250, k=20,
+    6 categories, LEXIMIN Gini 47.4 % / min 4.0 % / runtime 8.8 s,
+    ``reference_output/sf_b_20_statistics.txt:2-5,9,15``)."""
+    return skewed_instance(
+        n=250,
+        k=20,
+        n_categories=6,
+        features_per_category=[2, 3, 3, 2, 4, 3],
+        seed=seed,
+        skew=0.7,
+        name="sf_b_skewed_20",
+    )
+
+
+def sf_c_skewed_instance(seed: int = 1) -> Instance:
+    """Heterogeneous synthetic stand-in shaped like ``sf_c_44`` (n=161, k=44,
+    7 categories, LEXIMIN Gini 52.5 % / min 8.6 % / runtime 6.0 s,
+    ``reference_output/sf_c_44_statistics.txt:2-5,9,15``)."""
+    return skewed_instance(
+        n=161,
+        k=44,
+        n_categories=7,
+        features_per_category=[2, 3, 2, 3, 2, 3, 2],
+        seed=seed,
+        skew=0.7,
+        name="sf_c_skewed_44",
     )
 
 

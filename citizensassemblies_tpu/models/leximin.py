@@ -74,6 +74,13 @@ class Distribution:
     output_lines: List[str]
     fixed_probabilities: np.ndarray  # float64[n] leximin values per agent
     covered: np.ndarray  # bool[n] agent appears in some feasible committee
+    #: max |allocation − fixed_probabilities| of the panel realization; the
+    #: framework contract is ≤ 1e-3 (``contract_ok``). A budget-expired
+    #: agent-space rescue (see ``Config.agent_space_budget_s``) may ship a
+    #: certified profile realized only to ``realization_dev`` — explicitly
+    #: flagged here and in ``output_lines``, never silently.
+    realization_dev: float = 0.0
+    contract_ok: bool = True
 
     @property
     def panels(self) -> List[Tuple[int, ...]]:
@@ -342,13 +349,16 @@ def _typespace_leximin(
     if final_stage != "l2" and total_dev > 1e-3:
         # the panel realization missed the framework's 1e-3 L∞ contract
         # (e.g. a stalled household-disjoint pricing loop): never ship it
-        # silently — returning None sends the caller to the agent-space CG,
-        # which is exact regardless of the type-space machinery
+        # silently — the caller falls back to the agent-space CG, which is
+        # exact regardless of the type-space machinery. The out-of-contract
+        # result is still returned (flagged contract_ok=False): its PROFILE
+        # is probe-certified even though the realization lags, so it serves
+        # as the budget-expiry rescue of a stalled agent-space fallback
+        # (VERDICT r4 #3) instead of being discarded.
         log.emit(
             f"Type-space realization missed the 1e-3 contract "
             f"(dev {total_dev:.2e}); falling back to agent-space CG."
         )
-        return None
     log.emit(format_timers(log.timers))
     return Distribution(
         committees=P,
@@ -357,6 +367,8 @@ def _typespace_leximin(
         output_lines=list(log.lines),
         fixed_probabilities=fixed_agent,
         covered=covered,
+        realization_dev=total_dev,
+        contract_ok=bool(final_stage == "l2" or total_dev <= 1e-3),
     )
 
 
@@ -398,6 +410,7 @@ def find_distribution_leximin(
     # rows on an augmented instance (see ``solvers/quotient.py``) — so the
     # same pipeline runs, with household-disjoint panel realization. A valid
     # mid-run agent-space checkpoint means CG work exists to resume, honor it.
+    ts_fallback: Optional[Distribution] = None
     if not initial_panels and not cfg.force_agent_space:
         has_ckpt = checkpoint_path is not None and (
             load_cg_state(checkpoint_path, n, problem_fingerprint(dense, cfg, households))
@@ -406,8 +419,6 @@ def find_distribution_leximin(
         if not has_ckpt:
             if households is None:
                 dist = _typespace_leximin(dense, cfg, log, final_stage, checkpoint_path)
-                if dist is not None:
-                    return dist
             else:
                 from citizensassemblies_tpu.solvers.quotient import (
                     build_household_quotient,
@@ -433,8 +444,15 @@ def find_distribution_leximin(
                         f"{exc}); falling back to agent-space CG."
                     )
                     dist = None
-                if dist is not None:
+            if dist is not None:
+                if dist.contract_ok:
                     return dist
+                # contract miss: run the exact agent-space CG, but keep the
+                # certified-profile realization as the budget-expiry rescue —
+                # at flagship scale the agent-space CG can take hours, and a
+                # silent multi-hour stall is worse than an explicit ε-wide
+                # result (VERDICT r4 #3)
+                ts_fallback = dist
 
     key = jax.random.PRNGKey(cfg.solver_seed)
     portfolio = _Portfolio(n)
@@ -479,7 +497,40 @@ def find_distribution_leximin(
 
     # Outer loop: maximize the min of unfixed probabilities, fix the tranche of
     # agents whose dual weight certifies tightness, repeat (leximin.py:381-449).
+    # When a certified-profile type-space fallback exists, the loop runs under
+    # a wall-clock budget: past it, the ε-wide fallback ships with an explicit
+    # statement instead of letting the CG grind for hours (the independent
+    # n=800 cross-check did not finish in 3.5 h — tests/test_certification.py).
+    import time as _time
+
+    deadline = (
+        _time.monotonic() + cfg.agent_space_budget_s
+        if ts_fallback is not None and cfg.agent_space_budget_s > 0
+        else None
+    )
+    def _budget_expired() -> Optional[Distribution]:
+        if deadline is None or _time.monotonic() <= deadline:
+            return None
+        # ship the certified-profile fallback with an explicit ε statement;
+        # append only log lines the fallback snapshot does not already hold
+        # (its output_lines were initialized from this same RunLog)
+        ts_fallback.output_lines.extend(log.lines[len(ts_fallback.output_lines):])
+        msg = (
+            f"Agent-space CG exceeded its {cfg.agent_space_budget_s:.0f} s "
+            f"budget with {int((fixed >= 0).sum())}/{n} probabilities "
+            f"fixed; shipping the certified type-space profile realized "
+            f"to L-inf {ts_fallback.realization_dev:.2e} (above the 1e-3 "
+            f"contract — treat per-agent probabilities as exact to that "
+            f"tolerance only)."
+        )
+        log.emit(msg)
+        ts_fallback.output_lines.append(msg)
+        return ts_fallback
+
     while (fixed < 0).any():
+        expired = _budget_expired()
+        if expired is not None:
+            return expired
         log.emit(f"Fixed {int((fixed >= 0).sum())}/{n} probabilities.")
         if checkpoint_path is not None:
             save_cg_state(
@@ -504,6 +555,12 @@ def find_distribution_leximin(
         # carries the tail exactly as the reference's loop does
         stochastic_fails = 0
         while True:
+            # the budget must also bound a single stage's inner CG loop — a
+            # stalled pricing loop inside one stage is exactly the
+            # multi-hour scenario the budget exists for
+            expired = _budget_expired()
+            if expired is not None:
+                return expired
             P = portfolio.matrix()
             authoritative = True  # sol comes from exact host HiGHS
             with log.timer("dual_lp"):
@@ -660,6 +717,7 @@ def find_distribution_leximin(
     log.emit(format_timers(log.timers))
     if checkpoint_path is not None:
         clear_cg_state(checkpoint_path)
+    total_dev = float(np.max(np.abs(allocation - fixed)))
     return Distribution(
         committees=P,
         probabilities=probs,
@@ -667,4 +725,6 @@ def find_distribution_leximin(
         output_lines=list(log.lines),
         fixed_probabilities=fixed,
         covered=covered,
+        realization_dev=total_dev,
+        contract_ok=bool(final_stage == "l2" or total_dev <= 1e-3),
     )

@@ -190,6 +190,7 @@ def find_distribution_xmin(
                 f"(L∞ dev {float(np.abs(allocation - t).max()):.2e} ≤ band {band:g})."
             )
     log.emit(f"XMIN done: support {(probs > 1e-11).sum()} committees, ε = {eps_dev:.2e}.")
+    final_dev = float(np.abs(allocation - leximin.fixed_probabilities).max())
     return Distribution(
         committees=P,
         probabilities=probs,
@@ -197,4 +198,6 @@ def find_distribution_xmin(
         output_lines=list(log.lines),
         fixed_probabilities=leximin.fixed_probabilities,
         covered=leximin.covered,
+        realization_dev=final_dev,
+        contract_ok=bool(final_dev <= 1e-3),
     )

@@ -66,11 +66,20 @@ class CompositionOracle:
         self._integrality = np.ones(T)
 
     def maximize(
-        self, weights: np.ndarray, forced_type: Optional[int] = None
+        self, weights: np.ndarray, forced_type: Optional[int] = None,
+        rel_gap: float = 0.0,
     ) -> Optional[Tuple[np.ndarray, float]]:
         """Best feasible composition for per-type ``weights``; optionally force
         ``c_t ≥ 1`` for one type (the coverage solves of ``leximin.py:279-289``).
-        Returns None when infeasible."""
+        Returns None when infeasible.
+
+        ``rel_gap`` relaxes the MILP's optimality gap for callers that use the
+        result as a *heuristic column* rather than a certificate (the face
+        loop's anchor columns: acceptance there is the arithmetic residual of
+        the master iterate, so anchor optimality buys nothing — but each
+        exact solve at T ≈ 1000 costs ~0.2 s and the anchors were ~20 % of
+        the flagship decomposition wall-clock). Certification calls keep the
+        exact default."""
         lo = np.zeros(self.red.T)
         if forced_type is not None:
             lo[forced_type] = 1.0
@@ -79,6 +88,7 @@ class CompositionOracle:
             constraints=self._constraints,
             bounds=scipy.optimize.Bounds(lo, self.red.msize.astype(np.float64)),
             integrality=self._integrality,
+            options={"mip_rel_gap": rel_gap} if rel_gap > 0.0 else None,
         )
         if res.status != 0 or res.x is None:
             return None
@@ -259,8 +269,9 @@ def _marginal_probe_confirm(
                 -w, A_ub=quota_A, b_ub=quota_b, A_eq=A_eq, b_eq=[k], bounds=bnds
             )
             if r.status == 0:
-                return float(-r.fun)
-            return -np.inf if r.status == 2 else None  # infeasible vs failed
+                return float(-r.fun), np.asarray(r.x)
+            # infeasible vs failed — no optimizer either way
+            return (-np.inf, None) if r.status == 2 else (None, None)
         return fm
 
     face_max = _face_max_over(bounds)
@@ -519,6 +530,22 @@ def _slice_relaxation(
     )
     if streamed is not None:
         return list(streamed)
+
+    if chunks > 1:
+        # match the native semantics without the toolchain (ADVICE r4):
+        # `chunks` independent phase-spaced streams of R // chunks slices,
+        # run sequentially — same offsets (j0 + i·(1<<16)) and hull
+        # diversity as the parallel native streams
+        out: List[np.ndarray] = []
+        sizes = [R // chunks + (1 if i < R % chunks else 0) for i in range(chunks)]
+        for i, r in enumerate(sizes):
+            out.extend(
+                _slice_relaxation(
+                    x, reduction, R=r, j0=j0 + i * (1 << 16), chunks=1,
+                    max_passes=max_passes,
+                )
+            )
+        return out
 
     T = reduction.T
     k = reduction.k

@@ -209,13 +209,14 @@ def leximin_over_compositions(
         bounds_p = [(0, None)] * C
 
         def _face_max_over(rhs):
-            def fm(obj_rows: np.ndarray) -> Optional[float]:
+            def fm(obj_rows: np.ndarray):
                 nonlocal lp_solves
                 r = _linprog(-obj_rows, A_p, rhs, A_eq_p, [1.0], bounds_p)
                 lp_solves += 1
                 if r.status == 0:
-                    return float(-r.fun)
-                return -np.inf if r.status == 2 else None  # infeasible vs failed
+                    return float(-r.fun), np.asarray(r.x)
+                # infeasible vs failed — no optimizer either way
+                return (-np.inf, None) if r.status == 2 else (None, None)
             return fm
 
         face_max = _face_max_over(b_p)

@@ -187,8 +187,11 @@ def _master_pdhg(
     max_iters: int,
     tol: float,
 ) -> Tuple[float, np.ndarray, np.ndarray, float, Optional[tuple], bool]:
-    """One approximate master solve on device: the two-sided ε-LP of
-    ``cg_typespace._decomp_lp`` handed to the warm-started PDHG core.
+    """One approximate master solve on device: the two-sided ε-LP handed to
+    the STRUCTURED warm-started PDHG core (``lp_pdhg.solve_two_sided_master``
+    — only MT is shipped and kept resident; the ± row structure is applied
+    arithmetically, halving both the tunnel transfer and the per-iteration
+    HBM traffic of the stacked-matrix formulation).
 
     Returns ``(eps_realized, w, p_norm, eps_obj, warm', ok)`` where
     ``eps_realized = ‖M p_norm − v‖∞`` is the *arithmetic* certificate of the
@@ -198,31 +201,11 @@ def _master_pdhg(
     own convergence flag. Columns are bucket-padded so the jitted core
     compiles once per bucket (same idiom as ``solve_stage_lp_pdhg``).
     """
-    from citizensassemblies_tpu.solvers.lp_pdhg import solve_lp
+    from citizensassemblies_tpu.solvers.lp_pdhg import solve_two_sided_master
 
     T, C = MT.shape
-    bucket = 2048
-    Cp = ((C + bucket - 1) // bucket) * bucket
-    G = np.zeros((2 * T, Cp + 1))
-    G[:T, :C] = -MT
-    G[T:, :C] = MT
-    G[:, Cp] = -1.0
-    h = np.concatenate([-v, v])
-    A = np.zeros((1, Cp + 1))
-    A[0, :C] = 1.0
-    b = np.array([1.0])
-    c = np.zeros(Cp + 1)
-    c[Cp] = 1.0
-    if warm is not None:
-        x0 = np.zeros(Cp + 1)
-        m = min(C, len(warm[0]) - 1)
-        x0[:m] = warm[0][:m]
-        x0[Cp] = warm[0][-1]
-        warm = (x0, warm[1], warm[2])
-    sol = solve_lp(
-        c, G, h, A, b,
-        cfg=cfg.replace(pdhg_max_iters=max_iters),
-        warm=warm, tol=tol,
+    sol = solve_two_sided_master(
+        MT, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters
     )
     p = np.maximum(sol.x[:C], 0.0)
     total = p.sum()
@@ -239,7 +222,7 @@ def _master_pdhg(
     eps_real = float(np.abs(MT @ p_norm - v).max())
     lam = np.maximum(sol.lam, 0.0)
     w = lam[:T] - lam[T:]
-    return eps_real, w, p_norm, float(sol.x[Cp]), (sol.x, sol.lam, sol.mu), sol.ok
+    return eps_real, w, p_norm, float(sol.objective), (sol.x, sol.lam, sol.mu), sol.ok
 
 
 def realize_profile(
@@ -402,21 +385,26 @@ def realize_profile(
                     solve_decomp_master_sharded,
                 )
 
-                eps, w, p, eps_obj, _ok = solve_decomp_master_sharded(
-                    MT, v, default_mesh(), cfg=cfg, tol=master_tol
-                )
+                with log.timer("decomp_master"):
+                    eps, w, p, eps_obj, _ok = solve_decomp_master_sharded(
+                        MT, v, default_mesh(), cfg=cfg, tol=master_tol
+                    )
                 pdhg_warm = None
                 lp_solves += 1
             else:
                 # adaptive budget: far from acceptance the duals only need
                 # to be roughly right to aim the expansion; near it the
                 # iterate itself must realize v, so spend the iterations
-                # where they matter
+                # where they matter. (A 4× deeper near-phase budget was
+                # measured NOT to cut the round count — the iterate lag on
+                # the hard seeds is hull quality, not iteration starvation —
+                # while adding ~0.5 s/master, so the budgets stay here.)
                 far = not eps_hist or eps_hist[-1] > 6 * accept
-                eps, w, p, eps_obj, pdhg_warm, _ok = _master_pdhg(
-                    MT, v, cfg, pdhg_warm,
-                    max_iters=4_096 if far else 12_288, tol=master_tol,
-                )
+                with log.timer("decomp_master"):
+                    eps, w, p, eps_obj, pdhg_warm, _ok = _master_pdhg(
+                        MT, v, cfg, pdhg_warm,
+                        max_iters=4_096 if far else 12_288, tol=master_tol,
+                    )
                 lp_solves += 1
             # end-game: the approximate objective says the support should be
             # able to realize v, but the first-order iterate's own residual
@@ -432,7 +420,8 @@ def realize_profile(
                 or (deep and eps_obj <= 1.2 * accept)
             )
             if eps > accept and near and rnd >= polish_after:
-                C_sup, p_sup, eps_sup = polish_support(p)
+                with log.timer("decomp_polish"):
+                    C_sup, p_sup, eps_sup = polish_support(p)
                 log.emit(
                     f"  polish: {len(C_sup)} support cols → ε={eps_sup:.2e} "
                     f"(iterate ε={eps:.2e}, obj≈{eps_obj:.2e})."
@@ -455,7 +444,8 @@ def realize_profile(
                 # incommensurable quantities
                 polish_after = rnd + 2
         else:
-            eps, w, _mu, p = _decomp_lp(MT, v)
+            with log.timer("decomp_master"):
+                eps, w, _mu, p = _decomp_lp(MT, v)
             lp_solves += 1
         eps_hist.append(eps)
         if best is None or eps < best[2]:
@@ -503,9 +493,10 @@ def realize_profile(
         base = len(cols)
         cand: List[np.ndarray] = []
         if kept:
-            cand.append(
-                neighbor_columns(np.stack(kept[:512]), reduction, r_norm)
-            )
+            with log.timer("decomp_expand"):
+                cand.append(
+                    neighbor_columns(np.stack(kept[:512]), reduction, r_norm)
+                )
         if (
             T <= cfg.decomp_host_master_max_types
             and rnd == 0
@@ -539,40 +530,50 @@ def realize_profile(
         # are *compound* moves no single swap reaches. The noisy variants
         # only diversify, so they run on alternate rounds; the forced-
         # inclusion anchors below are the aimed ones and run every round.
-        got = oracle.maximize(-r_norm)
-        if got is not None:
-            cand.append(got[0][None, :].astype(np.int16))
-        if rnd % 2 == 0:
-            scale = float(np.mean(np.abs(r_norm))) + 1e-12
-            for _ in range(2):
-                got = oracle.maximize(-r_norm + rng.normal(0.0, 0.5 * scale, T))
-                if got is not None:
-                    cand.append(got[0][None, :].astype(np.int16))
-        # forced-inclusion anchors on the worst under-served types: a type
-        # whose deficit persists needs columns that *contain* it, which the
-        # global dual direction alone may never produce (rare types have
-        # near-zero objective weight); forcing c_t ≥ 1 yields exactly such
-        # a compound column per MILP call
-        realized = MT @ p if len(p) == MT.shape[1] else None
-        if realized is not None:
-            deficit = v - realized
-            worst = np.argsort(-deficit)[:3]
-            for t in worst:
-                if deficit[t] > 0.25 * eps and reduction.msize[t] > 0:
-                    got = oracle.maximize(-r_norm, forced_type=int(t))
+        with log.timer("decomp_oracle"):
+            # anchors are HEURISTIC columns (acceptance is the master
+            # iterate's arithmetic residual), so a 1 % MILP gap is free
+            # quality-wise and cuts the anchor solves' share of the
+            # decomposition wall-clock (~20 % measured on the flagship)
+            got = oracle.maximize(-r_norm, rel_gap=1e-2)
+            if got is not None:
+                cand.append(got[0][None, :].astype(np.int16))
+            if rnd % 2 == 0:
+                scale = float(np.mean(np.abs(r_norm))) + 1e-12
+                for _ in range(2):
+                    got = oracle.maximize(
+                        -r_norm + rng.normal(0.0, 0.5 * scale, T), rel_gap=1e-2
+                    )
                     if got is not None:
                         cand.append(got[0][None, :].astype(np.int16))
+            # forced-inclusion anchors on the worst under-served types: a type
+            # whose deficit persists needs columns that *contain* it, which the
+            # global dual direction alone may never produce (rare types have
+            # near-zero objective weight); forcing c_t ≥ 1 yields exactly such
+            # a compound column per MILP call
+            realized = MT @ p if len(p) == MT.shape[1] else None
+            if realized is not None:
+                deficit = v - realized
+                worst = np.argsort(-deficit)[:3]
+                for t in worst:
+                    if deficit[t] > 0.25 * eps and reduction.msize[t] > 0:
+                        got = oracle.maximize(
+                            -r_norm, forced_type=int(t), rel_gap=1e-2
+                        )
+                        if got is not None:
+                            cand.append(got[0][None, :].astype(np.int16))
         added = 0
         if cand:
-            batch = np.concatenate([np.atleast_2d(c) for c in cand], axis=0)
-            # grow the master where it helps: most negative ⟨r, c/m⟩ first
-            # (r_norm = −w/m, so ascending r_norm-value = descending dual
-            # improvement w·c/m)
-            vals = batch.astype(np.float64) @ r_norm
-            order = np.argsort(vals)
-            cap = max(256, master_cap - len(cols))
-            for i in order[:cap]:
-                added += add(batch[i])
+            with log.timer("decomp_expand"):
+                batch = np.concatenate([np.atleast_2d(c) for c in cand], axis=0)
+                # grow the master where it helps: most negative ⟨r, c/m⟩ first
+                # (r_norm = −w/m, so ascending r_norm-value = descending dual
+                # improvement w·c/m)
+                vals = batch.astype(np.float64) @ r_norm
+                order = np.argsort(vals)
+                cap = max(256, master_cap - len(cols))
+                for i in order[:cap]:
+                    added += add(batch[i])
         obj_note = f" obj≈{eps_obj:.2e}" if use_pdhg else ""
         log.emit(
             f"  face round {rnd + 1}: ε={eps:.2e}{obj_note} added {added} "
@@ -586,7 +587,8 @@ def realize_profile(
         C_best, p_best, _ = best
         cols = [c for c in C_best]
         p = p_best
-    C_sup, p_sup, eps = polish_support(p if len(p) == len(cols) else None)
+    with log.timer("decomp_polish"):
+        C_sup, p_sup, eps = polish_support(p if len(p) == len(cols) else None)
     log.emit(
         f"Face decomposition: ε = {eps:.2e} on {len(C_sup)} support columns "
         f"({lp_solves} master solves)."

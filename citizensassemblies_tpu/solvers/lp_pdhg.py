@@ -253,6 +253,266 @@ def solve_lp(
     )
 
 
+# --- structured two-sided decomposition master ------------------------------
+
+
+@partial(jax.jit, static_argnames=("max_iters", "check_every"))
+def _pdhg_two_sided_core(
+    MT, v, colmask, x0, lam0, mu0, tol, max_iters: int, check_every: int
+):
+    """PDHG specialized to the face-decomposition master
+
+        min ε  s.t.  v − ε ≤ MT p ≤ v + ε,  Σp = 1,  p ≥ 0, ε ≥ 0.
+
+    The generic core materializes the stacked ``[[−MT, −1], [MT, −1]]``
+    constraint matrix — 2× the bytes shipped through the TPU tunnel and 2×
+    the HBM traffic per iteration, for rows that are exact negations. Here
+    only MT is resident: each iteration computes ``u = MT @ p`` once and
+    applies the ± structure arithmetically, and the Ruiz/power-norm
+    preconditioning exploits that rows t and T+t have identical magnitudes
+    (so one row scale serves both sides). Same restart-to-average scheme
+    and KKT semantics as ``_pdhg_core``; returns ``(x, lam, mu, iters,
+    res)`` with ``x = [p (C), ε]``, ``lam = [λ_lo (T), λ_up (T)]`` so
+    callers recover the pricing duals ``w = λ_lo − λ_up`` exactly as from
+    the generic core's row order.
+    """
+    T, C = MT.shape
+    f32 = MT.dtype
+
+    # --- Ruiz equilibration on the structured system ------------------------
+    # K's distinct row blocks: the T two-sided rows (magnitude |MT| plus the
+    # ε column of ones) and the Σp = 1 row. d_r[t] scales BOTH sign copies.
+    d_r = jnp.ones(T, dtype=f32)
+    d_e = jnp.ones((), dtype=f32)  # eq-row scale
+    d_c = jnp.ones(C, dtype=f32)
+    d_eps = jnp.ones((), dtype=f32)
+
+    absMT = jnp.abs(MT)
+
+    def ruiz_body(_, carry):
+        d_r, d_e, d_c, d_eps = carry
+        S = d_r[:, None] * absMT * d_c[None, :]
+        row_ineq = jnp.maximum(jnp.max(S, axis=1), d_r * d_eps)
+        # the Σp row spans only REAL columns (colmask zeroes the bucket
+        # padding — with padded eq coefficients the solver parks probability
+        # mass on zero-objective padding variables and the real columns'
+        # normalized sum silently drifts off 1)
+        row_eq = jnp.max(d_e * d_c * colmask)
+        col = jnp.maximum(jnp.max(S, axis=0), d_e * d_c * colmask)
+        col_eps = jnp.max(d_r) * d_eps
+        rn = jnp.where(row_ineq > 0, jnp.sqrt(jnp.maximum(row_ineq, 1e-10)), 1.0)
+        ren = jnp.where(row_eq > 0, jnp.sqrt(jnp.maximum(row_eq, 1e-10)), 1.0)
+        cn = jnp.where(col > 0, jnp.sqrt(jnp.maximum(col, 1e-10)), 1.0)
+        cen = jnp.where(col_eps > 0, jnp.sqrt(jnp.maximum(col_eps, 1e-10)), 1.0)
+        return d_r / rn, d_e / ren, d_c / cn, d_eps / cen
+
+    d_r, d_e, d_c, d_eps = jax.lax.fori_loop(
+        0, 8, ruiz_body, (d_r, d_e, d_c, d_eps)
+    )
+
+    Ms = d_r[:, None] * MT * d_c[None, :]  # scaled MT (shared by both sides)
+    e_col = d_r * d_eps  # scaled ε-column magnitude per two-sided row
+    a_row = d_e * d_c * colmask  # scaled Σp-row coefficients (real cols only)
+    # scaled data: h_lo = −(v − slack)·d_r for the −MT side, h_up = v·d_r
+    hs_lo = -v * d_r
+    hs_up = v * d_r
+    bs = 1.0 * d_e
+    cs_eps = 1.0 * d_eps  # objective coefficient of ε (scaled)
+
+    def K_apply(p, eps):
+        """[G; A] @ x in scaled coordinates: returns (r_lo, r_up, r_eq)."""
+        u = Ms @ p
+        return -u - e_col * eps, u - e_col * eps, jnp.dot(a_row, p)
+
+    def KT_apply(l_lo, l_up, mu):
+        """[G; A]ᵀ [λ; μ]: returns (grad_p, grad_eps)."""
+        g_p = Ms.T @ (l_up - l_lo) + mu * a_row
+        g_e = -jnp.dot(e_col, l_lo + l_up)
+        return g_p, g_e
+
+    # power iteration for ‖K‖ via the structured matvecs
+    def pow_body(_, vv):
+        p, e = vv
+        r_lo, r_up, r_eq = K_apply(p, e)
+        g_p, g_e = KT_apply(r_lo, r_up, r_eq)
+        nrm = jnp.sqrt(jnp.sum(g_p**2) + g_e**2) + 1e-12
+        return g_p / nrm, g_e / nrm
+    p0n = jnp.ones(C, dtype=f32) / jnp.sqrt(jnp.float32(C + 1))
+    e0n = jnp.ones((), dtype=f32) / jnp.sqrt(jnp.float32(C + 1))
+    pv, ev = jax.lax.fori_loop(0, 40, pow_body, (p0n, e0n))
+    r_lo, r_up, r_eq = K_apply(pv, ev)
+    g_p, g_e = KT_apply(r_lo, r_up, r_eq)
+    norm = jnp.sqrt(jnp.sqrt(jnp.sum(g_p**2) + g_e**2) + 1e-12)
+
+    scale = (
+        1.0
+        + jnp.abs(cs_eps)
+        + jnp.sqrt(jnp.sum(hs_lo**2) + jnp.sum(hs_up**2))
+        + jnp.abs(bs)
+    )
+
+    # warm start into scaled coordinates
+    p = x0[:C] / jnp.maximum(d_c, 1e-12)
+    eps = x0[C] / jnp.maximum(d_eps, 1e-12)
+    l_lo = jnp.maximum(lam0[:T] / jnp.maximum(d_r, 1e-12), 0.0)
+    l_up = jnp.maximum(lam0[T:] / jnp.maximum(d_r, 1e-12), 0.0)
+    mu = mu0 / jnp.maximum(d_e, 1e-12)
+
+    def kkt(p, eps, l_lo, l_up, mu):
+        r_lo, r_up, r_eq = K_apply(p, eps)
+        pri = jnp.sqrt(
+            jnp.sum(jnp.maximum(r_lo - hs_lo, 0.0) ** 2)
+            + jnp.sum(jnp.maximum(r_up - hs_up, 0.0) ** 2)
+            + (r_eq - bs) ** 2
+        )
+        g_p, g_e = KT_apply(l_lo, l_up, mu)
+        dua = jnp.sqrt(
+            jnp.sum(jnp.minimum(g_p, 0.0) ** 2)
+            + jnp.minimum(g_e + cs_eps, 0.0) ** 2
+        )
+        pobj = cs_eps * eps
+        dobj = -(l_lo @ hs_lo) - (l_up @ hs_up) - mu * bs
+        gap = jnp.abs(pobj - dobj)
+        return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+
+    def one_iter(carry, _):
+        (p, eps, l_lo, l_up, mu, ps, es, lls, lus, ms, tau, sigma) = carry
+        g_p, g_e = KT_apply(l_lo, l_up, mu)
+        p_new = jnp.maximum(p - tau * g_p, 0.0)
+        eps_new = jnp.maximum(eps - tau * (g_e + cs_eps), 0.0)
+        pb = 2.0 * p_new - p
+        eb = 2.0 * eps_new - eps
+        r_lo, r_up, r_eq = K_apply(pb, eb)
+        l_lo_new = jnp.maximum(l_lo + sigma * (r_lo - hs_lo), 0.0)
+        l_up_new = jnp.maximum(l_up + sigma * (r_up - hs_up), 0.0)
+        mu_new = mu + sigma * (r_eq - bs)
+        return (
+            p_new, eps_new, l_lo_new, l_up_new, mu_new,
+            ps + p_new, es + eps_new, lls + l_lo_new, lus + l_up_new,
+            ms + mu_new, tau, sigma,
+        ), None
+
+    def block(state):
+        (p, eps, l_lo, l_up, mu, p_av, e_av, ll_av, lu_av, m_av, it, res, omega) = state
+        tau = 0.9 * omega / norm
+        sigma = 0.9 / (omega * norm)
+        p_in, ll_in, lu_in, mu_in = p, l_lo, l_up, mu
+        zeros = (
+            jnp.zeros_like(p), jnp.zeros_like(eps), jnp.zeros_like(l_lo),
+            jnp.zeros_like(l_up), jnp.zeros_like(mu),
+        )
+        (p, eps, l_lo, l_up, mu, ps, es, lls, lus, ms, _, _), _ = jax.lax.scan(
+            one_iter,
+            (p, eps, l_lo, l_up, mu) + zeros + (tau, sigma),
+            None,
+            length=check_every,
+        )
+        inv = 1.0 / check_every
+        pa = (p_av + ps * inv) * 0.5
+        ea = (e_av + es * inv) * 0.5
+        lla = (ll_av + lls * inv) * 0.5
+        lua = (lu_av + lus * inv) * 0.5
+        ma = (m_av + ms * inv) * 0.5
+        r_cur = kkt(p, eps, l_lo, l_up, mu)
+        r_avg = kkt(pa, ea, lla, lua, ma)
+        better = r_avg < r_cur
+        p = jnp.where(better, pa, p)
+        eps = jnp.where(better, ea, eps)
+        l_lo = jnp.where(better, lla, l_lo)
+        l_up = jnp.where(better, lua, l_up)
+        mu = jnp.where(better, ma, mu)
+        res = jnp.minimum(r_cur, r_avg)
+        dx = jnp.linalg.norm(p - p_in)
+        dy = jnp.sqrt(
+            jnp.sum((l_lo - ll_in) ** 2)
+            + jnp.sum((l_up - lu_in) ** 2)
+            + (mu - mu_in) ** 2
+        )
+        moved = (dx > 1e-12) & (dy > 1e-12)
+        omega_new = jnp.sqrt(omega * jnp.clip(dy / jnp.maximum(dx, 1e-12), 1e-4, 1e4))
+        omega = jnp.where(moved, jnp.clip(omega_new, 1.0 / 64.0, 64.0), omega)
+        return (p, eps, l_lo, l_up, mu, pa, ea, lla, lua, ma, it + check_every, res, omega)
+
+    def cond(state):
+        return (state[11] > tol) & (state[10] < max_iters)
+
+    state0 = (
+        p, eps, l_lo, l_up, mu, p, eps, l_lo, l_up, mu,
+        jnp.int32(0), jnp.float32(jnp.inf), jnp.float32(1.0),
+    )
+    (p, eps, l_lo, l_up, mu, *_rest) = jax.lax.while_loop(cond, block, state0)
+    it, res = _rest[5], _rest[6]
+
+    x_out = jnp.concatenate([p * d_c, (eps * d_eps)[None]])
+    lam_out = jnp.concatenate([l_lo * d_r, l_up * d_r])
+    mu_out = (mu * d_e)[None]
+    return x_out, lam_out, mu_out, it, res
+
+
+def solve_two_sided_master(
+    MT: np.ndarray,
+    v: np.ndarray,
+    cfg: Optional[Config] = None,
+    warm: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    tol: Optional[float] = None,
+    max_iters: Optional[int] = None,
+    bucket: int = 2048,
+) -> LPSolution:
+    """Device solve of the two-sided ε master via the structured core.
+
+    Drop-in for the ``solve_lp`` call that ``face_decompose._master_pdhg``
+    used to make on the stacked matrix, with identical (x, lam, mu) layout:
+    ``x = [p (Cp), ε]``, ``lam = [λ_lo (T), λ_up (T)]`` (so the pricing
+    duals are ``lam[:T] − lam[T:]``), ``mu = [μ]``. Columns are padded to
+    ``bucket`` so the jitted core compiles once per bucket.
+    """
+    cfg = cfg or default_config()
+    tol = float(tol if tol is not None else cfg.pdhg_tol)
+    T, C = MT.shape
+    Cp = ((C + bucket - 1) // bucket) * bucket
+    MTp = np.zeros((T, Cp), dtype=np.float32)
+    MTp[:, :C] = MT
+    f32 = jnp.float32
+    if warm is not None:
+        x0 = np.zeros(Cp + 1, dtype=np.float32)
+        m = min(C, len(warm[0]) - 1)
+        x0[:m] = warm[0][:m]
+        x0[Cp] = warm[0][-1]
+        lam0 = np.zeros(2 * T, dtype=np.float32)
+        lam0[: min(2 * T, len(warm[1]))] = warm[1][: 2 * T]
+        mu0 = np.float32(warm[2][0] if np.ndim(warm[2]) else warm[2])
+    else:
+        x0 = np.zeros(Cp + 1, dtype=np.float32)
+        lam0 = np.zeros(2 * T, dtype=np.float32)
+        mu0 = np.float32(0.0)
+    colmask = np.zeros(Cp, dtype=np.float32)
+    colmask[:C] = 1.0
+    x, lam, mu, it, res = _pdhg_two_sided_core(
+        jnp.asarray(MTp, f32),
+        jnp.asarray(v, f32),
+        jnp.asarray(colmask, f32),
+        jnp.asarray(x0, f32),
+        jnp.asarray(lam0, f32),
+        jnp.asarray(mu0, f32),
+        jnp.float32(tol),
+        max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
+        check_every=int(cfg.pdhg_check_every),
+    )
+    x = np.asarray(x, dtype=np.float64)
+    lam = np.asarray(lam, dtype=np.float64)
+    mu = np.asarray(mu, dtype=np.float64)
+    res_f = float(res)
+    return LPSolution(
+        ok=bool(res_f <= tol * 4.0),
+        x=x,
+        lam=lam,
+        mu=mu,
+        objective=float(x[Cp]),
+        iters=int(it),
+        kkt=res_f,
+    )
+
+
 # --- the two LP shapes of the LEXIMIN machinery -----------------------------
 
 

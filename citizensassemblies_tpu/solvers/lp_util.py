@@ -12,7 +12,7 @@ stages never abort an otherwise-exact solve.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
+from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 import scipy.optimize
@@ -61,23 +61,26 @@ ALLOWANCE_CAP = 1e-4
 
 
 def probe_confirm_tranche(
-    face_max: Callable[[np.ndarray], Optional[float]],
+    face_max: Callable[[np.ndarray], Tuple[Optional[float], Optional[np.ndarray]]],
     objectives: np.ndarray,
     z: float,
     probe_tol: float,
     allowances: np.ndarray,
     term_deficit: float = 0.0,
     log: Optional[Callable[[str], object]] = None,
-    face_max_relaxed: Optional[Callable[[np.ndarray], Optional[float]]] = None,
+    face_max_relaxed: Optional[
+        Callable[[np.ndarray], Tuple[Optional[float], Optional[np.ndarray]]]
+    ] = None,
 ) -> np.ndarray:
     """Certify which leximin tranche candidates are capped at ``z`` over a
     stage's optimal face.
 
-    ``face_max(w)`` maximizes ``w`` over the face; ``objectives[i]`` is
-    candidate i's value functional; ``allowances[i]`` bounds the spurious
-    headroom constraint slack can grant candidate i (see the callers'
-    slack-gain derivations; clamped to :data:`ALLOWANCE_CAP` so a certificate
-    never exceeds a tolerance material against the 1e-3 bar);
+    ``face_max(w)`` maximizes ``w`` over the face and returns ``(value,
+    x_opt)`` — the optimizer feeds the witness elimination below;
+    ``objectives[i]`` is candidate i's value functional; ``allowances[i]``
+    bounds the spurious headroom constraint slack can grant candidate i (see
+    the callers' slack-gain derivations; clamped to :data:`ALLOWANCE_CAP` so
+    a certificate never exceeds a tolerance material against the 1e-3 bar);
     ``term_deficit`` is how far below ``z`` a candidate's value may sit on the
     face (the callers relax the face floors to ``z − margin − slack``, so each
     term is only ≥ ``z − term_deficit`` there).
@@ -89,7 +92,22 @@ def probe_confirm_tranche(
     chunk's LARGEST allowance — sound only when every member's own
     allowance covers it. Chunks therefore group candidates of equal
     allowance (≈ equal pool size), sized so the ``(g−1)·term_deficit``
-    inflation stays immaterial; per-candidate probes resolve disagreement.
+    inflation stays immaterial.
+
+    Disagreeing chunks resolve by **witness elimination**, not per-candidate
+    probes: the failed group LP's own optimizer ``x*`` values every candidate
+    at once (``objectives[i]·x*``), and any candidate above the certificate
+    bound at a *feasible face point* is thereby witnessed loose — drop it and
+    re-probe the survivors. Each iteration removes at least one member (the
+    argmax when none crosses the bound), so a tranche with ``l`` loose
+    candidates costs ``O(l)`` group LPs instead of one LP per member (a
+    mild-skew sf_e seed paid ~2500 per-candidate probe LPs ≈ 25–47 s under
+    the flat scheme; elimination cuts the stage cost to a handful of LPs).
+    A dropped candidate is merely deferred to a later stage — dropping can
+    never certify, so soundness is unaffected. A whole-tranche pre-probe at
+    the MINIMUM allowance (within every member's own budget) settles the
+    all-tight case — the common one — in a single LP even across mixed
+    allowances.
 
     An *infeasible* face from a group probe is never taken as evidence of
     tightness (this module's own header documents HiGHS falsely declaring
@@ -124,11 +142,11 @@ def probe_confirm_tranche(
 
     def probe_one(i: int) -> None:
         nonlocal infeasible_fixes
-        got = face_max(objectives[i])
+        got, _x = face_max(objectives[i])
         if got == -np.inf:
             if not face_state["checked"]:
                 face_state["checked"] = True
-                z0 = face_max(np.zeros_like(objectives[i]))
+                z0, _ = face_max(np.zeros_like(objectives[i]))
                 face_state["empty"] = z0 == -np.inf
                 if face_state["empty"] and log is not None:
                     log(
@@ -144,7 +162,7 @@ def probe_confirm_tranche(
                 # an empty face degrades the whole stage to per-candidate
                 # probes ending in the uncertified dual heuristic
                 if face_max_relaxed is not None:
-                    rv = face_max_relaxed(objectives[i])
+                    rv, _ = face_max_relaxed(objectives[i])
                     if (
                         rv is not None
                         and rv != -np.inf
@@ -153,7 +171,7 @@ def probe_confirm_tranche(
                         confirmed[i] = True
                 return
             if face_max_relaxed is not None:
-                rv = face_max_relaxed(objectives[i])
+                rv, _ = face_max_relaxed(objectives[i])
                 if rv is not None and rv != -np.inf:
                     # superset optimum ≥ face optimum: within budget it
                     # certifies, above budget it is genuine headroom —
@@ -177,8 +195,54 @@ def probe_confirm_tranche(
     # allowance value yields ~#distinct-pool-sizes probes per tranche
     # instead of one per candidate; chunk size is additionally capped so the
     # ``(g−1)·term_deficit`` inflation stays immaterial (≤ 10·probe_tol).
-    order = np.argsort(-allowances)
     max_infl = 10.0 * probe_tol
+
+    def resolve(chunk: np.ndarray, a_i: float) -> None:
+        """Certify an equal-allowance chunk by witness elimination (see the
+        docstring): probe the sum; on disagreement, drop members the group
+        optimizer itself witnesses loose and re-probe the survivors."""
+        active = np.asarray(chunk)
+        while len(active) > 1:
+            g = len(active)
+            got, xopt = face_max(np.sum(objectives[active], axis=0))
+            if got is None or got == -np.inf or xopt is None:
+                # infeasible/failed group face is never evidence of
+                # tightness: resolve the remaining members individually
+                # (probe_one owns the empty-face and superset-retry logic)
+                for idx in active:
+                    probe_one(int(idx))
+                return
+            if got <= g * z + probe_tol + a_i:
+                confirmed[active] = True
+                return
+            vals = objectives[active] @ xopt
+            # a candidate above the certificate bound at a FEASIBLE face
+            # point is witnessed loose — dropping defers it to a later
+            # stage, which can never falsely certify
+            loose = vals > z + probe_tol + a_i
+            if not loose.any():
+                # the excess is spread below any individual bound: drop the
+                # largest value so every iteration removes at least one
+                loose = vals >= vals.max() - 1e-12
+            active = active[~loose]
+        if len(active) == 1:
+            probe_one(int(active[0]))
+
+    # whole-tranche pre-probe at the MINIMUM allowance: certifying every
+    # member at min_allow is within each member's own budget, so one passing
+    # LP settles the entire tranche even across mixed allowances (it may
+    # spuriously fail when the freed slack genuinely concentrates — the
+    # equal-allowance chunks below then recover the precise verdicts)
+    order = np.argsort(-allowances)
+    if n > 1 and (n - 1) * term_deficit <= max_infl:
+        got, _x = face_max(np.sum(objectives[order], axis=0))
+        if (
+            got is not None
+            and got != -np.inf
+            and got <= n * z + probe_tol + float(allowances.min())
+        ):
+            confirmed[:] = True
+            return confirmed
     i = 0
     while i < n:
         j = i + 1
@@ -190,23 +254,7 @@ def probe_confirm_tranche(
             and (j - i) * term_deficit <= max_infl
         ):
             j += 1
-        chunk = order[i:j]
-        if len(chunk) == 1:
-            probe_one(int(chunk[0]))
-        else:
-            g = len(chunk)
-            got = face_max(np.sum(objectives[chunk], axis=0))
-            if (
-                got is not None
-                and got != -np.inf
-                and got <= g * z + probe_tol + a_i
-            ):
-                confirmed[chunk] = True
-            else:
-                # disagreement (or an infeasible/failed group face): resolve
-                # candidate by candidate within this chunk only
-                for idx in chunk:
-                    probe_one(int(idx))
+        resolve(order[i:j], a_i)
         i = j
     if infeasible_fixes and log is not None:
         log(

@@ -169,6 +169,16 @@ class Config:
     #: set the mesh carries it.
     master_shard_min_types: int = 4_096
 
+    #: wall-clock budget for the agent-space CG when it runs as the FALLBACK
+    #: of a type-space realization that missed the 1e-3 contract. Past the
+    #: budget the certified type-space profile ships with an explicit
+    #: realization-ε statement (``Distribution.contract_ok = False``) instead
+    #: of grinding a possibly multi-hour CG (the independent n=800 agent-space
+    #: cross-check did not finish in 3.5 h). 0 disables the budget; explicit
+    #: ``force_agent_space`` / warm-start runs are never budgeted (they have
+    #: no fallback to ship).
+    agent_space_budget_s: float = 600.0
+
     # --- backends -------------------------------------------------------------
     #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),
     #: "highs" (host scipy/HiGHS LPs and MILPs — the cross-check backend), or

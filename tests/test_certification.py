@@ -27,32 +27,11 @@ AGENT_SPACE = default_config().replace(force_agent_space=True)
 
 
 def _mass24_shaped(seed: int = 3) -> Instance:
-    """A mass_24-shaped instance: n=70, k=24, 5 categories, with two
-    categories fully pinned (min = max on every cell) — the degenerate/tight
-    regime SURVEY §7 flags as a top risk (the real mass pool is withheld;
-    shape from ``reference_output/mass_24_statistics.txt:2-4``)."""
-    base = random_instance(
-        n=70, k=24, n_categories=5, features_per_category=[2, 3, 2, 3, 2],
-        seed=seed, name="mass24_shaped",
-    )
-    cats = {}
-    for ci, (cat, feats) in enumerate(base.categories.items()):
-        names = list(feats)
-        counts = np.array(
-            [sum(1 for a in base.agents if a[cat] == f) for f in names], float
-        )
-        if ci < 2:
-            # pin to the proportional integer composition: min = max
-            exact = np.floor(counts / 70.0 * 24.0).astype(int)
-            order = np.argsort(-(counts / 70.0 * 24.0 - exact))
-            for j in order[: 24 - exact.sum()]:
-                exact[j] += 1
-            cats[cat] = {f: (int(c), int(c)) for f, c in zip(names, exact)}
-        else:
-            cats[cat] = feats
-    import dataclasses
+    """The mass_24-shaped tight-quota instance, shared with the bench's
+    baseline sweep (``core.generator.mass_like_instance``)."""
+    from citizensassemblies_tpu.core.generator import mass_like_instance
 
-    return dataclasses.replace(base, categories=cats)
+    return mass_like_instance(seed=seed)
 
 
 def test_mass24_shaped_tight_quotas_full_stack():
@@ -170,6 +149,85 @@ def test_skewed_n800_matches_agent_space_certified():
     assert audit["maximin_gap"] <= 1e-3, audit
 
 
+def _force_realization_miss(monkeypatch, shift: float = 2e-3):
+    """Monkeypatch ``decompose_with_pricing`` to perturb the returned panel
+    probabilities so the realized allocation misses the 1e-3 contract — the
+    failure mode the agent-space fallback exists for (a stalled household-
+    disjoint pricing loop in the wild; synthesized here deterministically)."""
+    from citizensassemblies_tpu.solvers import compositions
+
+    real = compositions.decompose_with_pricing
+
+    def miss(*args, **kwargs):
+        P, probs, eps = real(*args, **kwargs)
+        probs = np.asarray(probs, dtype=np.float64).copy()
+        if len(probs) >= 2:
+            # blend toward one panel: alloc' = (1−s)·alloc + s·P[b], so any
+            # agent in panel b with allocation below ~0.5 moves by > s/2
+            # (mass moved panel-to-panel is bounded by the heaviest panel's
+            # own probability, which a spread-out optimum keeps tiny)
+            b = int(np.argmax(probs))
+            probs *= 1.0 - 2.0 * shift
+            probs[b] += 2.0 * shift
+        return P, probs, eps
+
+    monkeypatch.setattr(compositions, "decompose_with_pricing", miss)
+
+
+def test_forced_contract_miss_budgeted_fallback(monkeypatch):
+    """A type-space realization that misses the 1e-3 contract routes to the
+    agent-space CG; when that CG exceeds ``agent_space_budget_s``, the
+    certified type-space profile ships with an explicit ε statement instead
+    of stalling for hours (VERDICT r4 #3). Fast shape for the default suite;
+    the at-scale demonstration is the RUN_SLOW n=800 test below."""
+    _force_realization_miss(monkeypatch)
+    inst = skewed_instance(
+        n=200, k=24, n_categories=5, seed=6, features_per_category=[2, 3, 4, 2, 3]
+    )
+    dense, space = featurize(inst)
+    cfg = default_config().replace(agent_space_budget_s=0.5)
+    dist = find_distribution_leximin(dense, space, cfg=cfg)
+    assert dist.contract_ok is False
+    assert dist.realization_dev > 1e-3  # the forced miss, honestly reported
+    assert any("budget" in line for line in dist.output_lines)
+    assert dist.allocation.sum() == pytest.approx(float(dense.k), abs=1e-6)
+    # the shipped allocation realizes the certified profile to the stated ε
+    dev = float(np.abs(dist.allocation - dist.fixed_probabilities).max())
+    assert dev == pytest.approx(dist.realization_dev, abs=1e-9)
+    assert dev < 5e-3  # ε-wide, not garbage: the perturbation scale
+
+
+@pytest.mark.skipif(
+    os.environ.get("RUN_SLOW") != "1",
+    reason="n=800 type-space solve is ~2 min on the CPU mesh; set RUN_SLOW=1 "
+    "(recorded evidence below)",
+)
+def test_forced_contract_miss_n800_budgeted_fallback(monkeypatch):
+    """At-scale graceful completion (VERDICT r4 #3's acceptance): a forced
+    realization miss at n=800 completes in minutes — the budget-expired
+    agent-space CG returns the certified type-space profile with the explicit
+    ε statement — where the unbudgeted CG did not finish in 3.5 h
+    (see test_skewed_n800_matches_agent_space_certified's budget note).
+
+    Recorded evidence run (2026-07-31, RUN_SLOW=1, 8-device CPU mesh):
+    passed in ~3 min end to end."""
+    _force_realization_miss(monkeypatch)
+    inst = skewed_instance(
+        n=800, k=80, n_categories=7, seed=4,
+        features_per_category=[2, 4, 5, 3, 2, 4, 6], skew=0.4,
+    )
+    dense, space = featurize(inst)
+    cfg = default_config().replace(agent_space_budget_s=5.0)
+    dist = find_distribution_leximin(dense, space, cfg=cfg)
+    assert dist.contract_ok is False
+    assert dist.realization_dev > 1e-3
+    assert any("budget" in line for line in dist.output_lines)
+    assert dist.allocation.sum() == pytest.approx(80.0, abs=1e-6)
+    dev = float(np.abs(dist.allocation - dist.fixed_probabilities).max())
+    assert dev == pytest.approx(dist.realization_dev, abs=1e-9)
+    assert dev < 5e-3
+
+
 def test_second_level_audit_certifies():
     """``audit_second_level`` (solver-independent level-2 certificate with
     Lagrangian S1-floor tightening — VERDICT r3 #6's second-level-audit
@@ -224,8 +282,14 @@ def test_full_profile_audit_certifies_every_level():
     assert prof["worst_gap"] <= 1e-3
     # the exact-MILP bound alone (no marginal-LP rescue) must certify every
     # level: the audit's independence from the type-space machinery is a
-    # measured per-run fact, not an assumption
-    assert prof["worst_gap_milp"] <= 1e-3, prof
+    # measured per-run fact, not an assumption. Its tolerance is LOOSER than
+    # the certified min-of-two bound's (ADVICE r4): the Lagrangian bound
+    # carries an integrality duality gap deep in the profile that the
+    # 8-step heuristic subgradient closes only approximately — on the
+    # measured instances it reaches 1e-3, but a seed/HiGHS-version change
+    # that deepens the profile can legitimately loosen it without the
+    # certificate (worst_gap, asserted tight above) being any weaker
+    assert prof["worst_gap_milp"] <= 5e-3, prof
     for lvl in prof["levels"]:
         assert lvl["certified_upper"] >= lvl["achieved"] - 1e-9
         assert lvl["milp_upper"] >= lvl["achieved"] - 1e-9

@@ -143,6 +143,51 @@ def test_pdhg_final_lp_matches_highs():
     assert np.sum(p_got) == pytest.approx(1.0, abs=1e-4)
 
 
+def test_structured_two_sided_master_matches_host():
+    """The structured master core (``solve_two_sided_master`` — only MT
+    resident, ± rows applied arithmetically) must reproduce the host-exact
+    two-sided ε-LP: same optimum ε, usable pricing duals, simplex-feasible
+    primal. This is the kernel behind every face-decomposition round."""
+    from citizensassemblies_tpu.solvers.cg_typespace import _decomp_lp
+    from citizensassemblies_tpu.solvers.lp_pdhg import solve_two_sided_master
+
+    rng = np.random.default_rng(17)
+    for trial in range(3):
+        T, C = 24, 160
+        # random compositions over small pools: columns of a plausible master
+        m = rng.integers(1, 9, T)
+        comps = np.minimum(rng.poisson(1.0, (C, T)), m[None, :])
+        MT = (comps / np.maximum(m, 1)[None, :]).T.astype(np.float64)
+        # target inside the hull, perturbed so ε* > 0
+        mix = rng.dirichlet(np.ones(C))
+        v = MT @ mix + rng.normal(0.0, 5e-3, T)
+        e_ref, w_ref, _mu, _p = _decomp_lp(MT, v)
+        sol = solve_two_sided_master(MT, v, tol=1e-7)
+        assert sol.ok, sol.kkt
+        p = np.maximum(sol.x[:C], 0.0)
+        # the KKT tolerance is scale-relative, so the raw iterate's simplex
+        # residual can sit at O(1e-2); the face loop consumes the NORMALIZED
+        # iterate (p / Σp) and its arithmetic residual, asserted tight below
+        assert p.sum() == pytest.approx(1.0, abs=0.05)
+        e_got = float(np.abs(MT @ (p / p.sum()) - v).max())
+        # the normalized iterate's arithmetic residual is what the face loop
+        # consumes — it must reach the exact optimum's neighborhood
+        assert e_got <= e_ref + 2e-4, (trial, e_got, e_ref)
+        assert sol.objective == pytest.approx(e_ref, abs=2e-4)
+        # pricing duals: same layout as the stacked formulation
+        w = sol.lam[:T] - sol.lam[T:]
+        assert w.shape == w_ref.shape
+        # warm restart with extra columns converges and stays consistent
+        extra = np.minimum(rng.poisson(1.0, (16, T)), m[None, :])
+        MT2 = np.concatenate([MT.T, extra / np.maximum(m, 1)[None, :]]).T
+        e_ref2, _w2, _mu2, _p2 = _decomp_lp(MT2, v)
+        sol2 = solve_two_sided_master(
+            MT2, v, warm=(sol.x, sol.lam, sol.mu), tol=1e-7
+        )
+        assert sol2.ok
+        assert sol2.objective == pytest.approx(e_ref2, abs=2e-4)
+
+
 def test_leximin_jax_backend_matches_hybrid():
     """Full column generation with device PDHG LPs reproduces the HiGHS-LP
     allocation (same math, different LP engine)."""
@@ -306,8 +351,9 @@ def test_probe_confirm_tranche_chunks_equal_allowances():
 
     def face_max(w):
         calls["n"] += 1
-        # every candidate is exactly tight at z on this synthetic face
-        return float(w.sum()) * z
+        # every candidate is exactly tight at z on this synthetic face; the
+        # optimizer (second element) is the witness point — tight everywhere
+        return float(w.sum()) * z, np.full(n, z)
 
     allowances = np.full(n, 1e-5)  # one allowance class
     conf = probe_confirm_tranche(
@@ -336,7 +382,8 @@ def test_probe_confirm_tranche_empty_face_certifies_nothing():
 
     logged = []
     conf = probe_confirm_tranche(
-        lambda w: -np.inf,  # every solve reports infeasible, incl. w = 0
+        # every solve reports infeasible, incl. w = 0
+        lambda w: (-np.inf, None),
         np.eye(4), 0.5, probe_tol=1e-7, allowances=np.full(4, 1e-6),
         term_deficit=1e-8, log=logged.append,
     )
@@ -352,8 +399,8 @@ def test_probe_confirm_tranche_spurious_infeasible_still_certifies():
 
     def face_max(w):
         if not w.any():  # feasibility probe: the face is non-empty
-            return 0.0
-        return -np.inf  # mis-reported objective solves
+            return 0.0, np.zeros_like(w)
+        return -np.inf, None  # mis-reported objective solves
 
     logged = []
     conf = probe_confirm_tranche(
