@@ -207,7 +207,7 @@ def main() -> None:
                     seed=2, features_per_category=[3, 4, 6, 3, 2, 4, 6]
                 ),
                 "sf_e_skewed_110",
-                reps,
+                1,
             ),
             ("sf_e_like", lambda: sf_e_like_instance(seed=0), "sf_e_like_110", 1),
         ]
@@ -282,6 +282,13 @@ def main() -> None:
                 }
                 if audit is not None:
                     detail[key]["exactness_audit"] = audit
+                if key == "sf_e_skewed_types":
+                    # stress variant BEYOND the real sf_e shape (T ≈ 1800
+                    # distinct types vs ≈ 1000 on the real feature schema):
+                    # the host-IPM polish dominates and the row is recorded
+                    # for attribution, not claimed at the ≥50× bar the
+                    # sf_e-class family rows meet
+                    detail[key]["stress_variant"] = True
 
     if os.environ.get("BENCH_SKIP_EXTRA", "") != "1":
         import numpy as np

@@ -525,6 +525,12 @@ def find_distribution_leximin(
         )
         log.emit(msg)
         ts_fallback.output_lines.append(msg)
+        # the run COMPLETED (with an explicit ε-wide result): leaving the
+        # agent-space checkpoint behind would make an identical rerun skip
+        # the type-space solve (no fallback ⇒ no deadline) and grind the
+        # unbudgeted multi-hour CG this budget exists to prevent
+        if checkpoint_path is not None:
+            clear_cg_state(checkpoint_path)
         return ts_fallback
 
     while (fixed < 0).any():

@@ -993,8 +993,18 @@ def leximin_cg_typespace(
             # misses (sf_d-class), the face loop's deep R=2048 pass (fresh
             # tie streams via j0) supplies the missing hull diversity at the
             # cost of one more master — cheaper than paying a deep stream
-            # plus a large first master on every instance
-            for c in _slice_relaxation(x_target, reduction, R=1024):
+            # plus a large first master on every instance. Beyond ~1k types
+            # the finer R=2048 stream pays for itself: the hull needs ~T
+            # columns and repair-drop rates rise with the feature count
+            # (the n=1200 household quotient, T=1199/F=626, kept only 331
+            # of 1024 slices and ground 19 face rounds from ε=2e-2; at
+            # R=2048 it keeps ~1400, starts at 1.4e-2, and runs 80→66 s —
+            # unlike the measured-unhelpful top-up of SEPARATE phase-shifted
+            # streams, one finer stream also tightens the cumulative
+            # apportionment feedback to ~1/2048)
+            for c in _slice_relaxation(
+                x_target, reduction, R=1024 if reduction.T <= 1024 else 2048
+            ):
                 injected += add_comp(c)
             # NOTE (measured): topping the hull up with extra phase-shifted
             # streams when injected < T (household-quotient instances start

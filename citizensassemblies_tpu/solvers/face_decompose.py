@@ -45,22 +45,30 @@ from citizensassemblies_tpu.utils.logging import RunLog
 def _feature_bitmasks(reduction: TypeReduction):
     """Per-type donor/receiver feature masks for the move-feasibility screen.
 
-    With F total features (≤ 64 on every reference-shaped instance) the
-    quota conditions of a unit move collapse to bit tests: moving a unit
+    The quota conditions of a unit move collapse to bit tests: moving a unit
     *out* of type ``t`` decrements each of ``t``'s features, which is safe
     iff the composition's count stays ≥ lo there; moving *in* increments,
-    safe iff ≤ hi. Returns ``(feat_mask[T] uint64, F)`` where
-    ``feat_mask[t]`` has the bits of ``t``'s features set, or ``None`` when
-    F > 64 (fall back to the dense screen).
+    safe iff ≤ hi. One 64-bit word covers every reference-shaped instance
+    (F ≤ 64). Instances with MORE features — the household quotient's
+    augmented incidence appends one one-hot class feature per household
+    class, F = base + #classes — split by category: categories whose
+    features all index < 64 ride the word, the rest are screened by direct
+    gathers in :func:`neighbor_columns` (one gather per category — for the
+    quotient that is the single class category, whose ``lo = 0`` even skips
+    the donor side). Returns ``(feat_mask[T] uint64, leftover_cats)`` where
+    ``leftover_cats`` lists category indices not covered by the mask, or
+    ``None`` when no category fits a word at all.
     """
-    F = reduction.F
-    if F > 64:
-        return None
     feat_of = np.asarray(reduction.type_feature)
+    ncat = feat_of.shape[1]
+    word_cats = [ci for ci in range(ncat) if int(feat_of[:, ci].max()) < 64]
+    if not word_cats:
+        return None
     masks = np.zeros(reduction.T, dtype=np.uint64)
-    for ci in range(feat_of.shape[1]):
+    for ci in word_cats:
         masks |= np.uint64(1) << feat_of[:, ci].astype(np.uint64)
-    return masks
+    leftover = [ci for ci in range(ncat) if ci not in word_cats]
+    return masks, leftover
 
 
 def neighbor_columns(
@@ -139,15 +147,17 @@ def neighbor_columns(
     counts = comps.astype(np.int64) @ tf  # [S, F]
 
     ok = (comps[:, ti] > 0) & (comps[:, tj] < m[tj][None, :])  # [S, P]
-    masks = _feature_bitmasks(reduction)
-    if masks is not None:
+    packed = _feature_bitmasks(reduction)
+    if packed is not None:
+        masks, leftover = packed
         # bit f set ⇔ this composition may donate (resp. receive) a unit of
         # feature f without breaking its quota
-        fbit = np.uint64(1) << np.arange(F, dtype=np.uint64)
-        can_sub = ((counts - 1 >= lo[None, :]).astype(np.uint64) * fbit).sum(
+        nb = min(F, 64)
+        fbit = np.uint64(1) << np.arange(nb, dtype=np.uint64)
+        can_sub = ((counts[:, :nb] - 1 >= lo[None, :nb]).astype(np.uint64) * fbit).sum(
             axis=1, dtype=np.uint64
         )  # [S]
-        can_add = ((counts + 1 <= hi[None, :]).astype(np.uint64) * fbit).sum(
+        can_add = ((counts[:, :nb] + 1 <= hi[None, :nb]).astype(np.uint64) * fbit).sum(
             axis=1, dtype=np.uint64
         )
         # features touched by the move: symmetric difference of the two
@@ -157,7 +167,20 @@ def neighbor_columns(
         need_add = masks[tj] & diff
         ok &= (need_sub[None, :] & ~can_sub[:, None]) == 0
         ok &= (need_add[None, :] & ~can_add[:, None]) == 0
-    else:  # pragma: no cover - no reference-shaped instance has F > 64
+        # categories beyond the word (the household quotient's class
+        # category): one [S, P] gather each. Its donor check vanishes when
+        # every lower quota is 0 (true for class caps [0, m_c]) — the slow
+        # all-gather fallback here was 62 s of a 130 s n=1200 household
+        # decomposition
+        for ci in leftover:
+            a_i = feat_of[ti, ci]
+            a_j = feat_of[tj, ci]
+            same = a_i == a_j
+            add_ok = counts[:, a_j] + 1 <= hi[a_j][None, :]
+            if (lo[feat_of[:, ci]] > 0).any():
+                add_ok &= counts[:, a_i] - 1 >= lo[a_i][None, :]
+            ok &= same[None, :] | add_ok
+    else:  # pragma: no cover - every instance has some ≤64-feature category
         for ci in range(ncat):
             a_i = feat_of[ti, ci]
             a_j = feat_of[tj, ci]

@@ -143,6 +143,46 @@ def test_cg_heterogeneous_matches_enumeration():
     assert np.max(np.abs(d_cg.allocation - d_en.allocation)) <= 1e-4
 
 
+def test_neighbor_columns_feasible_beyond_word_width():
+    """The face expansion's move screen on an instance with F > 64 (the
+    household quotient's augmented incidence): every emitted column must
+    still satisfy all quotas and Σc = k. Pins the hybrid screen — word
+    bitmask for base categories, direct gather for the class category —
+    that replaced the all-gather fallback (62 s of a 130 s n=1200 household
+    decomposition)."""
+    from citizensassemblies_tpu.core.generator import skewed_instance
+    from citizensassemblies_tpu.solvers.face_decompose import neighbor_columns
+    from citizensassemblies_tpu.solvers.quotient import build_household_quotient
+
+    inst = skewed_instance(n=240, k=16, n_categories=3, seed=7,
+                           features_per_category=[3, 3, 3])
+    dense, _ = featurize(inst)
+    hh = (np.arange(240) // 2).astype(np.int32)
+    q = build_household_quotient(dense, hh)
+    red = TypeReduction(q.dense_aug)
+    assert red.F > 64  # the regime under test
+
+    # feasible seed compositions straight from the exact oracle
+    oracle = CompositionOracle(red)
+    rng = np.random.default_rng(1)
+    comps = []
+    for _ in range(12):
+        got = oracle.maximize(rng.normal(0, 1.0, red.T))
+        if got is not None:
+            comps.append(got[0])
+    comps = np.stack(comps).astype(np.int16)
+    out = neighbor_columns(comps, red, rng.normal(0, 1e-3, red.T))
+    assert out.shape[0] > 0  # the screen admits genuine moves
+    tf = np.zeros((red.T, red.F), dtype=np.int64)
+    for t in range(red.T):
+        tf[t, red.type_feature[t]] = 1
+    counts = out.astype(np.int64) @ tf
+    assert np.all(out.sum(axis=1) == red.k)
+    assert np.all(counts >= red.qmin[None, :])
+    assert np.all(counts <= red.qmax[None, :])
+    assert np.all(out >= 0) and np.all(out <= red.msize[None, :])
+
+
 def test_stalled_band_accepts_instead_of_stage_cg():
     """A face residual above decomp_accept but inside the stalled band is
     accepted (stages == 0 — no stage-CG fallback) and the end-to-end
