@@ -244,7 +244,7 @@ def _typespace_leximin(
                     expand_compositions,
                 )
 
-                P, _ = expand_compositions(
+                P, p_seed = expand_compositions(
                     ts.compositions,
                     ts.probabilities,
                     reduction,
@@ -262,7 +262,7 @@ def _typespace_leximin(
                     ts.compositions.astype(np.float64)
                     / reduction.msize.astype(np.float64)[None, :]
                 )
-                P, _, _ = decompose_with_pricing(
+                P, p_seed, _ = decompose_with_pricing(
                     ts.compositions,
                     ts.probabilities,
                     reduction,
@@ -273,8 +273,12 @@ def _typespace_leximin(
                     tol=2e-5,
                     households=households,
                 )
+            # the expansion/decomposition probabilities are the feasible
+            # ε-floor donor, so the (possibly pathological) host ε-LP never
+            # runs here — see solve_final_primal_l2
             probs, eps_dev = solve_final_primal_l2(
-                P, fixed_agent, iters=cfg.xmin_qp_iters
+                P, fixed_agent, iters=cfg.xmin_qp_iters, log=log,
+                floor_donor=p_seed,
             )
         else:
             from citizensassemblies_tpu.solvers.compositions import decompose_with_pricing
@@ -360,6 +364,12 @@ def _typespace_leximin(
             f"(dev {total_dev:.2e}); falling back to agent-space CG."
         )
     log.emit(format_timers(log.timers))
+    # contract_ok reports the realized deviation HONESTLY on every path,
+    # including "l2": the l2 stage never falls back to agent space (its
+    # callers — XMIN, warm-start re-solves — gate the deviation with their
+    # own L∞ band machinery), but with the ε floor now coming from the
+    # decomposition donor instead of a minimal-ε LP, a stalled donor must
+    # surface as contract_ok=False rather than ship silently certified
     return Distribution(
         committees=P,
         probabilities=probs,
@@ -368,7 +378,7 @@ def _typespace_leximin(
         fixed_probabilities=fixed_agent,
         covered=covered,
         realization_dev=total_dev,
-        contract_ok=bool(final_stage == "l2" or total_dev <= 1e-3),
+        contract_ok=bool(total_dev <= 1e-3),
     )
 
 
@@ -445,7 +455,10 @@ def find_distribution_leximin(
                     )
                     dist = None
             if dist is not None:
-                if dist.contract_ok:
+                if dist.contract_ok or final_stage == "l2":
+                    # the l2 stage never falls back (its callers — XMIN,
+                    # warm re-solves — gate the deviation with their own
+                    # band machinery); contract_ok still reports honestly
                     return dist
                 # contract miss: run the exact agent-space CG, but keep the
                 # certified-profile realization as the budget-expiry rescue —
@@ -732,5 +745,5 @@ def find_distribution_leximin(
         fixed_probabilities=fixed,
         covered=covered,
         realization_dev=total_dev,
-        contract_ok=bool(final_stage == "l2" or total_dev <= 1e-3),
+        contract_ok=bool(total_dev <= 1e-3),
     )

@@ -118,10 +118,15 @@ def find_distribution_xmin(
         f"{P.shape[0]} committees ({drawn} draws)."
     )
 
-    # 3) min-L2 redistribution over the grown portfolio (xmin.py:447-455)
+    # 3) min-L2 redistribution over the grown portfolio (xmin.py:447-455).
+    # The LEXIMIN probabilities are the feasible ε-floor donor: they realize
+    # the targets within the leximin stage's own ε over the portfolio PREFIX,
+    # so the (possibly pathological — see solve_final_primal_l2) host ε-LP
+    # never runs on the expansion path
     with log.timer("xmin_l2"):
         probs, eps_dev = solve_final_primal_l2(
-            P, leximin.fixed_probabilities, iters=cfg.xmin_qp_iters, log=log
+            P, leximin.fixed_probabilities, iters=cfg.xmin_qp_iters, log=log,
+            floor_donor=leximin.probabilities,
         )
     probs = np.clip(probs, 0.0, 1.0)
     probs = probs / probs.sum()

@@ -18,7 +18,7 @@ gradient is the constraint residual — two matvecs per iteration, all jittable
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -68,16 +68,41 @@ def solve_final_primal_l2(
     iters: int = 20_000,
     eps_margin: float = 1e-6,
     log=None,
+    floor_donor: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, float]:
     """Committee probabilities realizing ``target`` within the minimal ε, with
     minimal L2 norm (maximal spread). Returns (p, ε). ``log`` (a ``RunLog``)
-    splits the host ε-LP from the device ascent in the phase timers."""
-    from citizensassemblies_tpu.solvers.highs_backend import solve_final_primal_lp
+    splits the host ε-LP from the device ascent in the phase timers.
+
+    ``floor_donor`` supplies a KNOWN feasible probability vector over (a
+    prefix of) ``P``'s rows — e.g. the LEXIMIN distribution the XMIN
+    expansion grew from, or the panel decomposition that produced ``P``.
+    With a donor, the ε floor is the donor's own realized deviation and the
+    host ε-LP is skipped entirely: on large portfolios with a degenerate
+    uniform target (example_large_200: 16.5k panels × n=2000, every
+    coverage row tight at the optimum) scipy's HiGHS crawled for over
+    30 minutes on that LP, while the donor answers in one matvec. The
+    donor ε upper-bounds the LP optimum, which only WIDENS the ascent's
+    band — the caller's final L∞ band check still gates the result."""
     from citizensassemblies_tpu.utils.logging import RunLog
 
     log = log or RunLog(echo=False)
-    with log.timer("l2_eps_lp"):
-        p_lp, eps_star = solve_final_primal_lp(P, target)
+    PT = P.T.astype(np.float64)
+    if floor_donor is not None:
+        p_lp = np.zeros(P.shape[0], dtype=np.float64)
+        p_lp[: len(floor_donor)] = np.asarray(floor_donor, dtype=np.float64)
+        s = p_lp.sum()
+        if s <= 0:
+            raise ValueError("floor donor carries no probability mass")
+        p_lp = p_lp / s
+        eps_star = float(np.abs(PT @ p_lp - np.asarray(target)).max())
+    else:
+        from citizensassemblies_tpu.solvers.highs_backend import (
+            solve_final_primal_lp,
+        )
+
+        with log.timer("l2_eps_lp"):
+            p_lp, eps_star = solve_final_primal_lp(P, target)
     eps = eps_star + eps_margin
 
     Pj = jnp.asarray(P, dtype=jnp.float32)
@@ -110,7 +135,6 @@ def solve_final_primal_l2(
     # Support stays the union of both supports, so the spread survives.
     p_lp = np.clip(np.asarray(p_lp, dtype=np.float64), 0.0, 1.0)
     p_lp = p_lp / p_lp.sum()
-    PT = P.T.astype(np.float64)
     alloc_l2 = PT @ p
     alloc_lp = PT @ p_lp
     floor = np.asarray(target, dtype=np.float64) - eps

@@ -210,7 +210,7 @@ def test_forced_contract_miss_n800_budgeted_fallback(monkeypatch):
     (see test_skewed_n800_matches_agent_space_certified's budget note).
 
     Recorded evidence run (2026-07-31, RUN_SLOW=1, 8-device CPU mesh):
-    passed in ~3 min end to end."""
+    passed in 147 s end to end."""
     _force_realization_miss(monkeypatch)
     inst = skewed_instance(
         n=800, k=80, n_categories=7, seed=4,
