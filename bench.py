@@ -356,6 +356,20 @@ def main() -> None:
                 "min_prob": round(float(r2.allocation[r2.covered].min()), 6),
                 "gini": round(st2.gini, 4),
             }
+            if base / max(el2, 1e-9) < 50 and base <= 50:
+                # the recorded reason for a sub-50× ratio on a SMALL-BASELINE
+                # row (gate: baseline ≤ 50 s — on larger baselines a sub-50×
+                # ratio is a real finding, not a floor artifact): per-run
+                # fixed costs (JAX dispatch through the TPU tunnel
+                # ~0.16 s/call, host LP/solver startup) floor any solve at a
+                # few hundred ms, so ratios against small baselines are
+                # capped by arithmetic, not by the algorithm — the absolute
+                # wall-clock is the informative number here
+                detail[name]["floor_note"] = (
+                    "sub-50x is the fixed per-run host/dispatch floor vs a "
+                    "small baseline; absolute wall-clock is the informative "
+                    "number"
+                )
 
         # XMIN at sf_e scale (VERDICT r2 item #5): the reference's costliest
         # path (iterated full re-solves, xmin.py:511-542) replaced by the
@@ -383,8 +397,9 @@ def main() -> None:
             "seconds": round(t_lex + el_x, 1),
             "expansion_seconds": round(el_x, 1),
             # phase split of the expansion (VERDICT r4 #6): device draws,
-            # host dedup, and the two halves of the min-L2 stage (host ε-LP
-            # + device dual ascent) — xmin_l2 covers l2_eps_lp+l2_dual_ascent
+            # host dedup, and the min-L2 stage (xmin_l2, containing the
+            # device min-ε anchor l2_eps_pdhg and the dual ascent
+            # l2_dual_ascent — the host ε-LP no longer runs on this path)
             "phase_times": {
                 k: round(v, 1)
                 for k, v in sorted(xlog.timers.items(), key=lambda kv: -kv[1])

@@ -278,7 +278,7 @@ def _typespace_leximin(
             # runs here — see solve_final_primal_l2
             probs, eps_dev = solve_final_primal_l2(
                 P, fixed_agent, iters=cfg.xmin_qp_iters, log=log,
-                floor_donor=p_seed,
+                floor_donor=p_seed, cfg=cfg,
             )
         else:
             from citizensassemblies_tpu.solvers.compositions import decompose_with_pricing

@@ -126,7 +126,11 @@ def find_distribution_xmin(
     with log.timer("xmin_l2"):
         probs, eps_dev = solve_final_primal_l2(
             P, leximin.fixed_probabilities, iters=cfg.xmin_qp_iters, log=log,
-            floor_donor=leximin.probabilities,
+            floor_donor=leximin.probabilities, cfg=cfg,
+            # the anchor gate must track THIS run's spread band: a donor
+            # whose deviation sits between the gate and the band would skip
+            # the anchor and silently disable the spread (step 4 below)
+            anchor_if_above=0.5 * cfg.xmin_linf_band,
         )
     probs = np.clip(probs, 0.0, 1.0)
     probs = probs / probs.sum()

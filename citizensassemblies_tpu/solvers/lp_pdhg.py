@@ -620,10 +620,21 @@ def solve_final_primal_lp_pdhg(
     P: np.ndarray,
     target: np.ndarray,
     cfg: Optional[Config] = None,
+    max_iters: Optional[int] = None,
+    tol: Optional[float] = None,
+    host_fallback: bool = True,
 ) -> Tuple[np.ndarray, float]:
     """Final primal LP (``leximin.py:453-464``) on device: min ε s.t.
-    Σp = 1, (Pᵀp)ᵢ ≥ targetᵢ − ε, p ≥ 0, ε ≥ 0. Returns (p, ε)."""
+    Σp = 1, (Pᵀp)ᵢ ≥ targetᵢ − ε, p ≥ 0, ε ≥ 0. Returns (p, ε).
+
+    ``host_fallback=False`` returns the (possibly unconverged) device
+    iterate instead of re-solving on host — for callers that validate the
+    iterate arithmetically and must never touch the host LP (see
+    ``qp._min_eps_pdhg``: scipy's HiGHS crawled >30 min on a degenerate
+    example_large-shaped instance of this very LP)."""
     cfg = cfg or default_config()
+    if max_iters is not None:
+        cfg = cfg.replace(pdhg_max_iters=int(max_iters))
     P = np.asarray(P, dtype=np.float64)
     C, n = P.shape
     target = np.asarray(target, dtype=np.float64)
@@ -633,8 +644,8 @@ def solve_final_primal_lp_pdhg(
     h = -target
     A = np.concatenate([np.ones(C), [0.0]])[None, :]
     b = np.array([1.0])
-    sol = solve_lp(c, G, h, A, b, cfg=cfg)
-    if not sol.ok:
+    sol = solve_lp(c, G, h, A, b, cfg=cfg, tol=tol)
+    if not sol.ok and host_fallback:
         from citizensassemblies_tpu.solvers.highs_backend import solve_final_primal_lp
 
         return solve_final_primal_lp(P, target)

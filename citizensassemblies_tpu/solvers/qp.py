@@ -3,10 +3,12 @@
 XMIN's final stage augments the ε-recovery LP with a quadratic term
 ``min ε + Σ_C p_C²`` (``xmin.py:447-455``) — the min-L2-norm tie-break that
 spreads probability over as many committees as possible. Here the solve is
-lexicographic instead of summed: first the LP finds the minimal feasible ε
-(``solvers/highs_backend.solve_final_primal_lp``), then this module minimizes
-``Σ p²`` subject to realizing the targets within that ε — the same
-support-spreading effect, with a clean TPU formulation.
+lexicographic instead of summed: first an ε floor is established — from the
+caller's feasible donor distribution (optionally tightened by a short device
+PDHG min-ε solve; the host LP runs only on donor-less calls, since HiGHS
+crawled >30 min on a degenerate example_large-shaped instance of it) — then
+this module minimizes ``Σ p²`` subject to realizing the targets within that
+ε: the same support-spreading effect, with a clean TPU formulation.
 
 The QP  min_{p ∈ Δ, Pᵀp ≥ t - ε} pᵀp  is solved via projected dual ascent:
 for multipliers λ ≥ 0 on the coverage constraints, the inner minimization over
@@ -62,6 +64,27 @@ def _min_norm_dual_ascent(P, t, eps, lr, iters: int):
     return p_of(lam)
 
 
+def _min_eps_pdhg(P: np.ndarray, PT: np.ndarray, target: np.ndarray, cfg=None):
+    """Approximate min-ε recovery LP on device via
+    ``lp_pdhg.solve_final_primal_lp_pdhg`` with NO host fallback: the caller
+    validates the normalized iterate arithmetically and keeps the better of
+    this and its donor. Short iteration budget — the iterate only needs to
+    beat the donor's deviation when the donor is loose; the default 100k
+    budget ground ~48 s on example_large's degenerate shape for accuracy
+    nothing downstream uses. Returns ``(p_normalized, two_sided_dev)``."""
+    from citizensassemblies_tpu.solvers.lp_pdhg import solve_final_primal_lp_pdhg
+
+    x, _eps = solve_final_primal_lp_pdhg(
+        P, target, cfg=cfg, max_iters=12_288, tol=1e-5, host_fallback=False
+    )
+    p = np.clip(x, 0.0, 1.0)
+    s = p.sum()
+    if not np.isfinite(s) or s <= 0:
+        return np.full(P.shape[0], 1.0 / max(P.shape[0], 1)), float("inf")
+    p = p / s
+    return p, float(np.abs(PT @ p - np.asarray(target)).max())
+
+
 def solve_final_primal_l2(
     P: np.ndarray,
     target: np.ndarray,
@@ -69,33 +92,51 @@ def solve_final_primal_l2(
     eps_margin: float = 1e-6,
     log=None,
     floor_donor: Optional[np.ndarray] = None,
+    cfg=None,
+    anchor_if_above: float = 4e-4,
 ) -> Tuple[np.ndarray, float]:
     """Committee probabilities realizing ``target`` within the minimal ε, with
     minimal L2 norm (maximal spread). Returns (p, ε). ``log`` (a ``RunLog``)
-    splits the host ε-LP from the device ascent in the phase timers.
+    records the phase timers: on the donor path ``l2_eps_pdhg`` (the device
+    min-ε anchor, run only when the donor's deviation exceeds
+    ``anchor_if_above``) and ``l2_dual_ascent``; without a donor, the host
+    ``l2_eps_lp`` plus the ascent.
 
     ``floor_donor`` supplies a KNOWN feasible probability vector over (a
     prefix of) ``P``'s rows — e.g. the LEXIMIN distribution the XMIN
     expansion grew from, or the panel decomposition that produced ``P``.
-    With a donor, the ε floor is the donor's own realized deviation and the
-    host ε-LP is skipped entirely: on large portfolios with a degenerate
-    uniform target (example_large_200: 16.5k panels × n=2000, every
-    coverage row tight at the optimum) scipy's HiGHS crawled for over
-    30 minutes on that LP, while the donor answers in one matvec. The
-    donor ε upper-bounds the LP optimum, which only WIDENS the ascent's
-    band — the caller's final L∞ band check still gates the result."""
+    With a donor, the HOST ε-LP is skipped entirely: on large portfolios
+    with a degenerate uniform target (example_large_200: 16.5k panels ×
+    n=2000, every coverage row tight at the optimum) scipy's HiGHS crawled
+    for over 30 minutes on that LP. The ε floor is then the better of the
+    donor's own realized deviation and one DEVICE PDHG min-ε solve (no host
+    fallback — its iterate is validated arithmetically): anchoring near the
+    grown portfolio's true minimal ε matters because the donor's deviation
+    alone can exceed the caller's spread band (leximin realizations budget
+    up to ~9e-4 at n ≥ 200 vs XMIN's 8e-4 band), which would silently
+    disable the support expansion the caller exists for."""
     from citizensassemblies_tpu.utils.logging import RunLog
 
     log = log or RunLog(echo=False)
     PT = P.T.astype(np.float64)
+    tgt = np.asarray(target, dtype=np.float64)
     if floor_donor is not None:
-        p_lp = np.zeros(P.shape[0], dtype=np.float64)
-        p_lp[: len(floor_donor)] = np.asarray(floor_donor, dtype=np.float64)
-        s = p_lp.sum()
+        p_don = np.zeros(P.shape[0], dtype=np.float64)
+        p_don[: len(floor_donor)] = np.asarray(floor_donor, dtype=np.float64)
+        s = p_don.sum()
         if s <= 0:
             raise ValueError("floor donor carries no probability mass")
-        p_lp = p_lp / s
-        eps_star = float(np.abs(PT @ p_lp - np.asarray(target)).max())
+        p_don = p_don / s
+        dev_don = float(np.abs(PT @ p_don - tgt).max())
+        p_lp, eps_star = p_don, dev_don
+        if dev_don > anchor_if_above:
+            # the anchor matters only when the donor's own deviation
+            # approaches a caller's band (XMIN: 8e-4); a tight donor skips
+            # the device solve outright
+            with log.timer("l2_eps_pdhg"):
+                p_pd, dev_pd = _min_eps_pdhg(P, PT, tgt, cfg=cfg)
+            if dev_pd < dev_don:
+                p_lp, eps_star = p_pd, dev_pd
     else:
         from citizensassemblies_tpu.solvers.highs_backend import (
             solve_final_primal_lp,

@@ -33,6 +33,29 @@ def test_xmin_example_small_allocation_and_support(reference_data_dir):
     assert (xmin.committees.sum(axis=1) == dense.k).all()
 
 
+def test_xmin_never_runs_the_host_eps_lp(reference_data_dir, monkeypatch):
+    """The XMIN expansion must take its ε floor from the leximin donor, not
+    the host minimal-ε LP: on example_large's degenerate uniform target that
+    LP crawled for over 30 minutes (16.5k panels × n=2000, every coverage
+    row tight at the optimum) while the donor answers in one matvec. Pinned
+    by poisoning the LP entry point for the duration of the XMIN call."""
+    from citizensassemblies_tpu.solvers import highs_backend
+
+    inst = read_instance_dir(reference_data_dir / "example_small_20")
+    dense, space = featurize(inst)
+    leximin = find_distribution_leximin(dense, space)
+
+    def boom(*a, **k):  # pragma: no cover - the point is it never runs
+        raise AssertionError("XMIN must not call the host eps-LP")
+
+    monkeypatch.setattr(highs_backend, "solve_final_primal_lp", boom)
+    xmin = find_distribution_xmin(dense, space, leximin=leximin)
+    np.testing.assert_allclose(
+        xmin.allocation, leximin.fixed_probabilities, atol=1e-3
+    )
+    assert int((xmin.probabilities > 1e-11).sum()) > len(leximin.support())
+
+
 def test_xmin_couples_spreads_support(reference_data_dir):
     inst = read_instance_dir(
         reference_data_dir / "couples_panel_from_twenty_people_no_constraints_2"
