@@ -139,12 +139,14 @@ def neighbor_columns(
     if P == 0:
         return np.zeros((0, T), dtype=np.int16)
 
-    # per-composition feature counts [S, F]
+    # per-composition feature counts [S, F]: float32 BLAS then cast — numpy
+    # integer matmuls bypass BLAS, and at quotient scale ([512, 1199] @
+    # [1199, 626]) the int64 product alone cost ~0.4 s per face round;
+    # counts ≤ k ≤ a few hundred, far inside float32's exact-integer range
     F = reduction.F
-    tf = np.zeros((T, F), dtype=np.int64)
-    for ci in range(ncat):
-        tf[np.arange(T), feat_of[:, ci]] = 1
-    counts = comps.astype(np.int64) @ tf  # [S, F]
+    tf = np.zeros((T, F), dtype=np.float32)
+    tf[np.repeat(np.arange(T), ncat), feat_of.ravel()] = 1.0
+    counts = (comps.astype(np.float32) @ tf).astype(np.int64)  # [S, F]
 
     ok = (comps[:, ti] > 0) & (comps[:, tj] < m[tj][None, :])  # [S, P]
     packed = _feature_bitmasks(reduction)

@@ -93,7 +93,7 @@ def solve_final_primal_l2(
     log=None,
     floor_donor: Optional[np.ndarray] = None,
     cfg=None,
-    anchor_if_above: float = 4e-4,
+    anchor_if_above: Optional[float] = None,
 ) -> Tuple[np.ndarray, float]:
     """Committee probabilities realizing ``target`` within the minimal ε, with
     minimal L2 norm (maximal spread). Returns (p, ε). ``log`` (a ``RunLog``)
@@ -118,6 +118,12 @@ def solve_final_primal_l2(
     from citizensassemblies_tpu.utils.logging import RunLog
 
     log = log or RunLog(echo=False)
+    if anchor_if_above is None:
+        # derive the gate from the configured spread band so a tightened
+        # band cannot open a (gate, band) window where the anchor is
+        # skipped yet the donor deviation already exceeds the band
+        band = getattr(cfg, "xmin_linf_band", 8e-4) if cfg is not None else 8e-4
+        anchor_if_above = 0.5 * band
     PT = P.T.astype(np.float64)
     tgt = np.asarray(target, dtype=np.float64)
     if floor_donor is not None:

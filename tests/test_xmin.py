@@ -55,6 +55,28 @@ def test_xmin_never_runs_the_host_eps_lp(reference_data_dir, monkeypatch):
     )
     assert int((xmin.probabilities > 1e-11).sum()) > len(leximin.support())
 
+    # force the device min-ε ANCHOR path too (anchor_if_above=0 makes every
+    # donor "loose"): it must run without the host LP, its iterate must be
+    # arithmetically validated, and the result must stay band-feasible —
+    # this pins the host_fallback=False plumbing the poisoned LP guards
+    from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
+    from citizensassemblies_tpu.utils.logging import RunLog
+
+    rlog = RunLog(echo=False)
+    probs, eps = solve_final_primal_l2(
+        leximin.committees, leximin.fixed_probabilities,
+        iters=2_000, log=rlog, floor_donor=leximin.probabilities,
+        anchor_if_above=0.0,
+    )
+    assert "l2_eps_pdhg" in rlog.timers  # the anchor actually ran
+    dev = float(
+        np.abs(
+            leximin.committees.T.astype(np.float64) @ probs
+            - leximin.fixed_probabilities
+        ).max()
+    )
+    assert dev <= 1e-3, dev
+
 
 def test_xmin_couples_spreads_support(reference_data_dir):
     inst = read_instance_dir(
