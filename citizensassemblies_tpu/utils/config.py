@@ -115,10 +115,11 @@ class Config:
     #: polish still runs), bounding the tail a slow-converging hull can add
     #: — the r3 flagship showed a 150 s worst-of-3 against a 62 s median.
     decomp_time_budget_s: float = 45.0
-    #: exact MILP pricing calls per decomposition round, at randomly perturbed
-    #: duals — each returns an extreme point of the composition polytope,
-    #: which grows the master's hull far faster than interior samples.
-    decomp_multicut: int = 32
+    # NOTE: an earlier `decomp_multicut` knob (exact MILPs per decomposition
+    # round) was absorbed into the face loop's fixed anchor schedule (one
+    # dual-direction anchor + alternate-round noisy pair + up to three
+    # forced-inclusion anchors, ``face_decompose.realize_profile``); it was
+    # removed rather than kept as dead config.
 
     # --- XMIN -----------------------------------------------------------------
     #: portfolio-expansion budget as a multiple of n, counted in *distinct*
