@@ -23,6 +23,18 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Post-mortem hook for in-process hangs: `kill -USR1 <pid>` dumps every
+# thread's Python stack to stderr without killing the run. Motivated by two
+# observed livelocks (98 % CPU, ≥55 min, no progress) of RUN_SLOW
+# certification tests inside a jitted CPU-mesh execution that completes in
+# minutes standalone — an XLA-CPU runtime flake this hook lets us attribute
+# next time instead of losing the evidence to a blind SIGINT.
+import faulthandler
+import signal
+
+if hasattr(signal, "SIGUSR1"):  # POSIX-only debug hook
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
 from pathlib import Path
 
 import pytest

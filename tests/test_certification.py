@@ -210,7 +210,14 @@ def test_forced_contract_miss_n800_budgeted_fallback(monkeypatch):
     (see test_skewed_n800_matches_agent_space_certified's budget note).
 
     Recorded evidence run (2026-07-31, RUN_SLOW=1, 8-device CPU mesh):
-    passed in 147 s end to end."""
+    passed in 147 s end to end STANDALONE. Flake note (same date): when run
+    in-process AFTER other RUN_SLOW tests, this test (and once its n=200
+    sibling) was twice observed to livelock inside a jitted CPU-mesh
+    execution (98 % CPU, no progress for ≥55 min) that standalone completes
+    in minutes — an XLA-CPU runtime interaction, not an algorithmic stall
+    (the budget logic under test fires on host wall-clock between solver
+    calls). Until attributed, run the RUN_SLOW set one test per process;
+    conftest registers SIGUSR1 → faulthandler for live stack dumps."""
     _force_realization_miss(monkeypatch)
     inst = skewed_instance(
         n=800, k=80, n_categories=7, seed=4,
