@@ -91,9 +91,11 @@ def test_skewed_n400_matches_agent_space_certified():
     certificate, ``/root/reference/leximin.py:429-431``) certifies the first
     leximin level.
 
-    Recorded evidence run (2026-07-31, RUN_SLOW=1, 8-device CPU mesh):
-    passed in ~25 min alongside the n=70/n=120 cross-checks — sorted-profile
-    agreement within 1e-3 and audit gap within 1e-3."""
+    Recorded evidence runs (RUN_SLOW=1, 8-device CPU mesh): 2026-07-31 r4,
+    ~25 min alongside the n=70/n=120 cross-checks; 2026-07-31 round-5 re-run
+    with the witness-elimination/structured-master stack, this test plus the
+    n=200 forced-miss test passed together in 10 min 21 s — sorted-profile
+    agreement within 1e-3 and audit gap within 1e-3 both times."""
     from citizensassemblies_tpu.solvers.highs_backend import audit_maximin
 
     inst = skewed_instance(
