@@ -218,12 +218,12 @@ def main() -> None:
                     rlog = RunLog(echo=False)
                     t0 = time.time()
                     sfe = find_distribution_leximin(sfe_dense, sfe_space, log=rlog)
-                    runs.append((time.time() - t0, rlog.timers))
+                    runs.append((time.time() - t0, rlog.timers, rlog.counters))
                 runs.sort(key=lambda r: r[0])
                 times = [r[0] for r in runs]
                 # phase split of the MEDIAN rep, so the breakdown matches the
                 # reported wall-clock (rep 1 may pay XLA compiles)
-                median_s, median_timers = runs[len(runs) // 2]
+                median_s, median_timers, _median_counters = runs[len(runs) // 2]
                 dev = float(abs(sfe.allocation - sfe.fixed_probabilities).max())
                 sfe_stats = prob_allocation_stats(
                     sfe.allocation, cap_for_geometric_mean=False
@@ -262,8 +262,15 @@ def main() -> None:
                     "baseline_estimated": True,
                     "speedup": round(BASELINES[base_key] / max(median_s, 1e-9), 1),
                     "alloc_linf_dev": round(dev, 8),
-                    "min_prob": round(float(sfe.allocation.min()), 6),
+                    # covered-mask form, matching the regime-sweep rows below
+                    # (flagship pools are fully coverable today, so the mask
+                    # is a no-op — the unified form keeps it that way by
+                    # construction rather than by coincidence)
+                    "min_prob": round(float(sfe.allocation[sfe.covered].min()), 6),
                     "gini": round(sfe_stats.gini, 4),
+                    # warm-hit / overlap attribution of the median rep (the
+                    # pipelined decomposition's counters, utils/profiling)
+                    "phase_counters": runs[len(runs) // 2][2],
                     "phase_times": {
                         k: round(v, 1) for k, v in sorted(
                             median_timers.items(), key=lambda kv: -kv[1]
@@ -277,7 +284,7 @@ def main() -> None:
                         {k: round(v, 1) for k, v in sorted(
                             timers.items(), key=lambda kv: -kv[1]
                         )}
-                        for _, timers in runs
+                        for _, timers, _counters in runs
                     ],
                 }
                 if audit is not None:
@@ -412,6 +419,12 @@ def main() -> None:
                 ),
                 8,
             ),
+            # PER-AGENT L∞ (VERDICT r5 missing #3): sorting can mask a
+            # permutation error, and XMIN's contract is per-agent
+            # preservation — this is the already-computed
+            # Distribution.realization_dev, recorded alongside the sorted
+            # comparison instead of only being asserted internally
+            "realization_dev": round(float(xm.realization_dev), 8),
             "min_prob": round(float(xm.allocation.min()), 6),
         }
 
@@ -490,6 +503,7 @@ def main() -> None:
                     k: round(v, 1)
                     for k, v in sorted(hlog.timers.items(), key=lambda kv: -kv[1])
                 },
+                "phase_counters": hlog.counters,
                 "exactness_audit": audit,
             }
 
@@ -515,17 +529,77 @@ def main() -> None:
         thr_dense, _ = featurize(sf_e_like_instance())
         detail["sampler_panels_per_s"] = _sampler_throughput(thr_dense)
 
-    print(
-        json.dumps(
-            {
-                "metric": f"leximin_wallclock_{inst.name}",
-                "value": round(elapsed, 2),
-                "unit": "s",
-                "vs_baseline": round(elapsed / baseline, 4),
-                "detail": detail,
+    result = {
+        "metric": f"leximin_wallclock_{inst.name}",
+        "value": round(elapsed, 2),
+        "unit": "s",
+        "vs_baseline": round(elapsed / baseline, 4),
+        "detail": detail,
+    }
+    print(json.dumps(result))
+
+    # Durable evidence (VERDICT r5 missing #1): the driver records only the
+    # LAST ~2000 characters of this process's output, and the flagship
+    # seed-family rows print first inside the single JSON line — so every
+    # prior round's committed artifact lost its own headline. Two fixes:
+    # (a) the COMPLETE per-round result is written to a committed
+    # BENCH_detail_rNN.json in the repo root (NN = one past the newest
+    # BENCH_r*.json, override with BENCH_DETAIL_PATH), and (b) a compact
+    # flagship summary prints as the FINAL line, inside any tail window.
+    detail_path = os.environ.get("BENCH_DETAIL_PATH")
+    if not detail_path:
+        import glob
+        import re
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        rounds = [
+            int(m.group(1))
+            for f in glob.glob(os.path.join(root, "BENCH_r*.json"))
+            for m in [re.match(r"BENCH_r(\d+)\.json$", os.path.basename(f))]
+            if m
+        ]
+        nn = (max(rounds) + 1) if rounds else 1
+        detail_path = os.path.join(root, f"BENCH_detail_r{nn:02d}.json")
+    try:
+        with open(detail_path, "w", encoding="utf-8") as fh:
+            json.dump(result, fh, indent=1)
+    except OSError as exc:  # never let the artifact write kill the bench
+        detail_path = f"(unwritable: {exc})"
+
+    summary = {"detail_file": os.path.basename(str(detail_path))}
+    flag = {}
+    for key in (
+        "sf_e_skewed", "sf_e_skewed_seed0", "sf_e_skewed_seed2",
+        "sf_e_skewed_seed5", "sf_e_skewed_tight", "sf_e_skewed_types",
+        "sf_e_like",
+    ):
+        row = detail.get(key)
+        if isinstance(row, dict) and "seconds" in row:
+            flag[key] = {
+                "s": row["seconds"],
+                "worst_s": max(row.get("runs_s", [row["seconds"]])),
+                "x": row.get("speedup"),
+                "linf": row.get("alloc_linf_dev"),
             }
-        )
-    )
+    if flag:
+        summary["flagship"] = flag
+    for key in ("households_n400", "households_n1200"):
+        row = detail.get(key)
+        if isinstance(row, dict):
+            audit = row.get("exactness_audit") or {}
+            summary[key] = {
+                "s": row["seconds"],
+                "decomp_s": row.get("phase_times", {}).get("decomp"),
+                "linf": row.get("alloc_linf_dev"),
+                "profile_ok": audit.get("profile_all_within_tol"),
+            }
+    if "xmin_sf_e_skewed" in detail:
+        xr = detail["xmin_sf_e_skewed"]
+        summary["xmin"] = {
+            "s": xr["seconds"],
+            "realization_dev": xr.get("realization_dev"),
+        }
+    print(json.dumps({"flagship_summary": summary}))
 
 
 if __name__ == "__main__":

@@ -59,7 +59,7 @@ from citizensassemblies_tpu.utils.checkpoint import (
 )
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.logging import RunLog
-from citizensassemblies_tpu.utils.profiling import format_timers
+from citizensassemblies_tpu.utils.profiling import format_counters, format_timers
 
 
 @dataclasses.dataclass
@@ -364,6 +364,11 @@ def _typespace_leximin(
             f"(dev {total_dev:.2e}); falling back to agent-space CG."
         )
     log.emit(format_timers(log.timers))
+    if log.counters:
+        # the pipelined decomposition's warm-hit / overlap attribution
+        # (decomp_master_warm, decomp_oracle_overlap_hit, ...) — the discrete
+        # complement of the phase timers above
+        log.emit(format_counters(log.counters))
     # contract_ok reports the realized deviation HONESTLY on every path,
     # including "l2": the l2 stage never falls back to agent space (its
     # callers — XMIN, warm-start re-solves — gate the deviation with their
@@ -734,6 +739,8 @@ def find_distribution_leximin(
         f"{exact_prices} exact pricing calls, final ε = {eps_dev:.2e}."
     )
     log.emit(format_timers(log.timers))
+    if log.counters:
+        log.emit(format_counters(log.counters))
     if checkpoint_path is not None:
         clear_cg_state(checkpoint_path)
     total_dev = float(np.max(np.abs(allocation - fixed)))

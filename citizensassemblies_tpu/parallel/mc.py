@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from citizensassemblies_tpu.core.instance import DenseInstance
 from citizensassemblies_tpu.models.legacy import _sample_panels_kernel, chain_keys_for
+from citizensassemblies_tpu.parallel.mesh import shard_map_compat
 
 
 def distributed_sample_panels(
@@ -57,11 +58,10 @@ def distributed_sample_panels(
         score_spec = P()
 
     @partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(("chains", "agents")), score_spec),
         out_specs=(P(("chains", "agents")), P(("chains", "agents"))),
-        check_vma=False,
     )
     def draw(local_keys, local_scores):
         return _sample_panels_kernel(
@@ -91,14 +91,13 @@ def distributed_mc_round(
     ndev = mesh.devices.size
     keys = jax.random.split(key, ndev)
 
-    # check_vma=False: the sampler's scan carries start replicated and become
-    # device-varying through the per-device keys; skip the varying-axis audit
+    # varying-axis audit off (shard_map_compat): the sampler's scan carries
+    # state replicated that becomes device-varying through the per-device keys
     @partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=P(("chains", "agents")),
         out_specs=(P(("chains", "agents")), P(("chains", "agents")), P(), P()),
-        check_vma=False,
     )
     def round_fn(local_keys):
         panels, ok = _sample_panels_kernel(dense, local_keys[0], per_device_batch)
@@ -121,11 +120,10 @@ def distributed_allocation(P_matrix, probs, mesh: Mesh):
     p_sharded = jax.device_put(probs, NamedSharding(mesh, P("chains")))
 
     @partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P("chains", "agents"), P("chains")),
         out_specs=P("agents"),
-        check_vma=False,
     )
     def matvec(P_local, p_local):
         return jax.lax.psum(P_local.T @ p_local, "chains")

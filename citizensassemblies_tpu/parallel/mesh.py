@@ -27,6 +27,29 @@ from jax.sharding import Mesh
 _DEFAULT_MESH: Optional[Mesh] = None
 
 
+def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` across the JAX API migration, varying-axis audit off.
+
+    Newer JAX exposes ``jax.shard_map`` with the audit knob named
+    ``check_vma``; 0.4-era releases only have
+    ``jax.experimental.shard_map.shard_map`` with it named ``check_rep``.
+    Every shard-mapped program in this package disables the audit (their
+    scans carry replicated state that becomes device-varying through
+    per-device keys), so one compat entry point keeps the same decorator
+    working on both — without it the whole ``parallel/`` layer fails to
+    even decorate on a 0.4 runtime.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
 def default_mesh() -> Mesh:
     """Process-wide chains×agents mesh over every visible device (cached).
 

@@ -43,6 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from citizensassemblies_tpu.parallel.mesh import shard_map_compat
 from citizensassemblies_tpu.solvers.highs_backend import DualSolution
 from citizensassemblies_tpu.utils.config import Config, default_config
 
@@ -57,11 +58,10 @@ def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
     """
 
     @partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(axes, None), P(axes), P(), P(), P(), P()),
         out_specs=(P(), P(axes), P(), P()),
-        check_vma=False,
     )
     def solve(G_l, h_l, c, a_row, b, tol):
         f32 = jnp.float32
@@ -184,27 +184,37 @@ def _run_core(
     block_iters: int,
     max_blocks: int,
 ):
-    """Shared marshalling for the sharded PDHG core: cache the shard_map
-    program per (mesh, block schedule), upload the row shards, run."""
+    """Shared marshalling for the sharded PDHG core: cache the COMPILED
+    program per (mesh, block schedule), upload the row shards pre-partitioned,
+    run. The jit wrapper (rather than an eagerly-executed shard_map) keeps one
+    compiled executable per bucketed shape, and every input arrives already
+    laid out in the sharding the program expects — the row shards via an
+    explicit row-parallel ``NamedSharding``, the small replicated vectors via
+    a replicated one — so successive masters of the same padded shape re-enter
+    the executable without any host-side re-layout of the carry. ``h`` is
+    donated (it is shape/sharding-matched with the returned λ shard), freeing
+    its buffer for the output instead of allocating a fresh one per round."""
     axes = mesh.axis_names
     key = (mesh, axes, block_iters, max_blocks)
     core = _CORE_CACHE.get(key)
     if core is None:
-        core = _sharded_core(mesh, axes, block_iters, max_blocks)
+        core = jax.jit(
+            _sharded_core(mesh, axes, block_iters, max_blocks),
+            donate_argnums=(1,),
+        )
         _CORE_CACHE[key] = core
-    G_dev = jax.device_put(
-        np.asarray(G, np.float32), NamedSharding(mesh, P(axes, None))
-    )
-    h_dev = jax.device_put(
-        np.asarray(h, np.float32), NamedSharding(mesh, P(axes))
-    )
+    row_sharding = NamedSharding(mesh, P(axes, None))
+    vec_sharding = NamedSharding(mesh, P(axes))
+    rep_sharding = NamedSharding(mesh, P())
+    G_dev = jax.device_put(np.asarray(G, np.float32), row_sharding)
+    h_dev = jax.device_put(np.asarray(h, np.float32), vec_sharding)
     return core(
         G_dev,
         h_dev,
-        jnp.asarray(c, jnp.float32),
-        jnp.asarray(a_row, jnp.float32),
-        jnp.asarray(b, jnp.float32),
-        jnp.asarray([tol], jnp.float32),
+        jax.device_put(np.asarray(c, np.float32), rep_sharding),
+        jax.device_put(np.asarray(a_row, np.float32), rep_sharding),
+        jax.device_put(np.asarray(b, np.float32), rep_sharding),
+        jax.device_put(np.asarray([tol], np.float32), rep_sharding),
     )
 
 

@@ -105,7 +105,17 @@ def _kkt_residual(c, G, h, A, b, x, lam, mu, scale):
     return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
 
 
-@partial(jax.jit, static_argnames=("max_iters", "check_every"))
+# the warm-start buffers (x0, lam0, mu0) are donated: they are loop-carried
+# iterates — each call's outputs become the next call's warm start, and the
+# wrappers below always materialize FRESH device arrays for them, so donation
+# lets XLA reuse the input buffers for the matching-shaped outputs instead of
+# allocating (and re-laying-out) a new carry every CG round. (CPU backends
+# ignore donation with a one-time note; the contract is unchanged.)
+@partial(
+    jax.jit,
+    static_argnames=("max_iters", "check_every"),
+    donate_argnums=(5, 6, 7),
+)
 def _pdhg_core(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: int):
     m1, nv = G.shape
     m2 = A.shape[0]
@@ -256,7 +266,13 @@ def solve_lp(
 # --- structured two-sided decomposition master ------------------------------
 
 
-@partial(jax.jit, static_argnames=("max_iters", "check_every"))
+# x0/lam0 donated as in ``_pdhg_core`` (mu0 is a scalar with no same-shaped
+# output, so donating it would only be rejected)
+@partial(
+    jax.jit,
+    static_argnames=("max_iters", "check_every"),
+    donate_argnums=(3, 4),
+)
 def _pdhg_two_sided_core(
     MT, v, colmask, x0, lam0, mu0, tol, max_iters: int, check_every: int
 ):

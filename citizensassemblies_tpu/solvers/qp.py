@@ -40,15 +40,17 @@ def project_simplex(v: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(v - theta, 0.0)
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def _min_norm_dual_ascent(P, t, eps, lr, iters: int):
+# lam0 is the loop-carried multiplier buffer: donated (its shape matches the
+# returned lam, so XLA reuses the buffer), and returned so repeat callers can
+# warm-start the ascent instead of re-climbing from zero
+@partial(jax.jit, static_argnames=("iters",), donate_argnums=(4,))
+def _min_norm_dual_ascent(P, t, eps, lr, lam0, iters: int):
     """Two-sided dual ascent: multipliers on BOTH ``Pᵀp ≥ t − ε`` and
     ``Pᵀp ≤ t + ε``. One-sided floors let the spread re-route surplus mass
     upward — on heterogeneous instances the overshoot concentrated several
     ×ε on individual agents, breaking the XMIN contract that per-agent
-    probabilities stay at their leximin values."""
+    probabilities stay at their leximin values. Returns ``(p, lam)``."""
     C, n = P.shape
-    lam0 = jnp.zeros((2 * n,), dtype=P.dtype)
 
     def p_of(lam):
         return project_simplex((P @ (lam[:n] - lam[n:])) / 2.0)
@@ -61,7 +63,7 @@ def _min_norm_dual_ascent(P, t, eps, lr, iters: int):
         return jnp.maximum(lam + lr * jnp.concatenate([resid_lo, resid_up]), 0.0)
 
     lam = jax.lax.fori_loop(0, iters, body, lam0)
-    return p_of(lam)
+    return p_of(lam), lam
 
 
 def _min_eps_pdhg(P: np.ndarray, PT: np.ndarray, target: np.ndarray, cfg=None):
@@ -164,8 +166,9 @@ def solve_final_primal_l2(
     sigma_sq = float(_power_norm(Pj)) ** 2
     L = max(sigma_sq / 2.0, 1.0)
     with log.timer("l2_dual_ascent"):
-        p = _min_norm_dual_ascent(
-            Pj, tj, jnp.float32(eps), jnp.float32(1.0 / L), iters
+        lam0 = jnp.zeros((2 * Pj.shape[1],), dtype=Pj.dtype)
+        p, _lam = _min_norm_dual_ascent(
+            Pj, tj, jnp.float32(eps), jnp.float32(1.0 / L), lam0, iters
         )
         # host materialization inside the timer: through a TPU tunnel,
         # block_until_ready alone does not drain the pipeline (see bench.py)

@@ -115,6 +115,38 @@ class Config:
     #: polish still runs), bounding the tail a slow-converging hull can add
     #: — the r3 flagship showed a 150 s worst-of-3 against a 62 s median.
     decomp_time_budget_s: float = 45.0
+    #: run the face loop's anchor-oracle MILP pricing in a worker thread,
+    #: double-buffered against the device master: round r's anchors are
+    #: SUBMITTED right after round r's duals arrive and HARVESTED at round
+    #: r+1's expansion, so the MILPs execute while the device solves the
+    #: next master (and while the polish/expansion run). Both settings use
+    #: the same one-round-lagged schedule — False merely executes the jobs
+    #: inline at the submit point — so the emitted column stream, and hence
+    #: the returned portfolio, is bit-identical between the two (the
+    #: regression contract ``tests/test_face_decompose.py`` pins). Anchors
+    #: are heuristic columns (acceptance is the master iterate's arithmetic
+    #: residual), so a one-round-stale aim costs at most an extra round
+    #: while removing decomp_oracle from the critical path entirely.
+    decomp_oracle_overlap: bool = True
+    #: carry the master's and polish's PDHG primal/dual iterates across CG
+    #: rounds and bucket growths (the saved iterate is re-padded into the
+    #: new bucket) instead of cold-starting every solve. False cold-starts
+    #: everything — the fallback when a warm iterate misbehaves.
+    decomp_warm_start: bool = True
+    #: consecutive warm-started master rounds without ε improvement before
+    #: the warm iterate is dropped once (cold restart): a stalled first-order
+    #: iterate can sit in a corner the fresh problem has moved away from,
+    #: and restarting from zero re-equilibrates faster than escaping it.
+    decomp_warm_stall_rounds: int = 3
+    #: screen the neighbor-expansion move candidates in one jitted batch per
+    #: round (two uint32 bitmask lanes + gathers, compiled once per pair
+    #: bucket) instead of the host numpy sweep. Engaged on accelerator
+    #: backends only — CPU-only runs keep the numpy sweep, where per-call
+    #: dispatch/compile overhead outweighs the batching (same routing logic
+    #: as the masters). Results are identical below ``per_round_cap``; above
+    #: it the batched path keeps the first (mass-ordered) feasible moves
+    #: where the numpy path subsamples randomly.
+    decomp_batched_expand: bool = True
     # NOTE: an earlier `decomp_multicut` knob (exact MILPs per decomposition
     # round) was absorbed into the face loop's fixed anchor schedule (one
     # dual-direction anchor + alternate-round noisy pair + up to three

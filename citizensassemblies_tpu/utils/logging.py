@@ -24,6 +24,7 @@ class RunLog:
         self.echo = echo
         self.file = file
         self._timers: dict[str, float] = {}
+        self._counters: dict[str, int] = {}
 
     def emit(self, message: str) -> str:
         """Record a line (the reference's ``_print`` at ``leximin.py:54-56``)."""
@@ -54,6 +55,16 @@ class RunLog:
     @property
     def timers(self) -> dict:
         return dict(self._timers)
+
+    def count(self, name: str, inc: int = 1) -> None:
+        """Accumulate a named event counter (e.g. warm-start hits, overlap
+        harvests) — the discrete sibling of :meth:`timer`, rendered by
+        :func:`citizensassemblies_tpu.utils.profiling.format_counters`."""
+        self._counters[name] = self._counters.get(name, 0) + inc
+
+    @property
+    def counters(self) -> dict:
+        return dict(self._counters)
 
 
 @contextmanager

@@ -50,3 +50,16 @@ def format_timers(timers: Dict[str, float]) -> str:
         for name, secs in sorted(timers.items(), key=lambda kv: -kv[1])
     ]
     return "phase times: " + ", ".join(parts)
+
+
+def format_counters(counters: Dict[str, int]) -> str:
+    """One-line phase-event attribution (warm-start hits, overlap harvests,
+    cold restarts — the pipelined decomposition's counterpart to the wall
+    timers), largest first."""
+    if not counters:
+        return "phase counters: (none recorded)"
+    parts = [
+        f"{name} {cnt}"
+        for name, cnt in sorted(counters.items(), key=lambda kv: -kv[1])
+    ]
+    return "phase counters: " + ", ".join(parts)

@@ -35,11 +35,76 @@ import signal
 if hasattr(signal, "SIGUSR1"):  # POSIX-only debug hook
     faulthandler.register(signal.SIGUSR1, all_threads=True)
 
+import functools
+import subprocess
+import sys
 from pathlib import Path
 
 import pytest
 
 REFERENCE_DATA = Path("/root/reference/data")
+
+#: child-process marker for :func:`subprocess_isolated` — when set, the
+#: wrapped test body executes normally (we ARE the isolated process)
+_ISOLATED_ENV = "CA_TPU_ISOLATED_TEST"
+
+
+def subprocess_isolated(timeout_s: float = 3600.0):
+    """Run the decorated test in its OWN pytest subprocess.
+
+    Motivation (VERDICT r5 weak #2): two RUN_SLOW certification tests were
+    observed to livelock (98 % CPU, ≥55 min, no progress) inside a jitted
+    CPU-mesh execution when run after other tests in one process, while
+    completing in minutes standalone — an XLA-CPU runtime interaction that a
+    shared process cannot defend against. fork() after JAX has initialized is
+    unsafe (XLA's thread pools don't survive it), so isolation is a fresh
+    interpreter: the parent re-invokes pytest on this one node id with a hard
+    timeout, and the child — marked via the environment — runs the body
+    normally (fixtures such as ``monkeypatch`` apply inside the child). A
+    timeout or failure in the child fails the parent test with the child's
+    output tail, so a livelock now costs ``timeout_s`` instead of the whole
+    evidence session.
+    """
+
+    def decorate(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if os.environ.get(_ISOLATED_ENV) == "1":
+                return fn(*args, **kwargs)
+            nodeid = f"tests/{Path(fn.__code__.co_filename).name}::{fn.__name__}"
+            env = dict(os.environ)
+            env[_ISOLATED_ENV] = "1"
+            env.setdefault("PALLAS_AXON_POOL_IPS", "")
+            try:
+                res = subprocess.run(
+                    [
+                        sys.executable, "-m", "pytest", nodeid, "-x", "-q",
+                        "-p", "no:cacheprovider", "-p", "no:randomly",
+                    ],
+                    cwd=str(Path(__file__).resolve().parent.parent),
+                    env=env,
+                    capture_output=True,
+                    text=True,
+                    timeout=timeout_s,
+                )
+            except subprocess.TimeoutExpired as exc:
+                tail = ((exc.stdout or "") + "\n" + (exc.stderr or ""))[-2000:]
+                pytest.fail(
+                    f"isolated run of {nodeid} exceeded {timeout_s:.0f}s "
+                    f"(the livelock guard). Output tail:\n{tail}",
+                    pytrace=False,
+                )
+            if res.returncode != 0:
+                tail = (res.stdout + "\n" + res.stderr)[-2000:]
+                pytest.fail(
+                    f"isolated run of {nodeid} failed "
+                    f"(rc={res.returncode}). Output tail:\n{tail}",
+                    pytrace=False,
+                )
+
+        return wrapper
+
+    return decorate
 
 
 @pytest.fixture(scope="session")
