@@ -6,3 +6,10 @@ test:
 
 bench:
 	python bench.py
+
+# graftlint (the repo's JAX-invariant checker — R1..R6, see README "Static
+# analysis & guard rails") plus a ruff style baseline when ruff is installed.
+# graftlint is stdlib-only, so this target needs no accelerator stack.
+lint:
+	python -m citizensassemblies_tpu.lint citizensassemblies_tpu/
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; else echo "ruff not installed; style baseline skipped (ruff.toml)"; fi

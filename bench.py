@@ -211,13 +211,35 @@ def main() -> None:
             ),
             ("sf_e_like", lambda: sf_e_like_instance(seed=0), "sf_e_like_110", 1),
         ]
+        from citizensassemblies_tpu.utils.guards import CompilationGuard, GuardViolation
+
+        # bounded-recompile assertion for warm reps: rep 1 may compile every
+        # padded bucket the instance shape needs, but later reps of the SAME
+        # instance must re-enter those executables — a steady-state rep that
+        # recompiles per CG round is exactly the invariant drift graftlint's
+        # runtime rails exist to catch. The bound is generous (a handful of
+        # fresh bucket crossings is legitimate); a violation is recorded on
+        # the row rather than killing the evidence run.
+        warm_rep_compile_bound = int(os.environ.get("BENCH_COMPILE_BOUND", "8"))
         for key, builder, base_key, n_reps in family:
                 sfe_dense, sfe_space = featurize(builder())
                 runs = []
-                for _ in range(n_reps):
+                compile_counts = []
+                compile_guard_ok = True
+                for rep in range(n_reps):
                     rlog = RunLog(echo=False)
+                    bound = warm_rep_compile_bound if rep > 0 else None
                     t0 = time.time()
-                    sfe = find_distribution_leximin(sfe_dense, sfe_space, log=rlog)
+                    try:
+                        with CompilationGuard(
+                            name="leximin", log=rlog, max_compiles=bound
+                        ) as cguard:
+                            sfe = find_distribution_leximin(
+                                sfe_dense, sfe_space, log=rlog
+                            )
+                    except GuardViolation:
+                        compile_guard_ok = False
+                    compile_counts.append(cguard.count)
                     runs.append((time.time() - t0, rlog.timers, rlog.counters))
                 runs.sort(key=lambda r: r[0])
                 times = [r[0] for r in runs]
@@ -271,6 +293,11 @@ def main() -> None:
                     # warm-hit / overlap attribution of the median rep (the
                     # pipelined decomposition's counters, utils/profiling)
                     "phase_counters": runs[len(runs) // 2][2],
+                    # XLA compiles per rep (utils/guards.CompilationGuard, in
+                    # rep order not time order) + whether every warm rep
+                    # stayed under BENCH_COMPILE_BOUND
+                    "xla_compiles_per_rep": compile_counts,
+                    "compile_guard_ok": compile_guard_ok,
                     "phase_times": {
                         k: round(v, 1) for k, v in sorted(
                             median_timers.items(), key=lambda kv: -kv[1]
@@ -580,6 +607,7 @@ def main() -> None:
                 "worst_s": max(row.get("runs_s", [row["seconds"]])),
                 "x": row.get("speedup"),
                 "linf": row.get("alloc_linf_dev"),
+                "compiles_ok": row.get("compile_guard_ok"),
             }
     if flag:
         summary["flagship"] = flag

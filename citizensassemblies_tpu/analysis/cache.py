@@ -44,6 +44,12 @@ class AlgorithmRun:
     output_lines: List[str]
     #: number of Monte-Carlo draws (LEGACY) or committees in support (others)
     num_draws: int = 0
+    #: the exact algorithms' realization-contract report (None for LEGACY):
+    #: max |allocation − certified profile| and whether it met the 1e-3 L∞
+    #: contract — a budget-expired rescue ships contract_ok=False
+    #: (``Distribution.contract_ok``), and the statistics report states it.
+    realization_dev: Optional[float] = None
+    contract_ok: Optional[bool] = None
 
     def to_payload(self) -> dict:
         return {
@@ -53,6 +59,8 @@ class AlgorithmRun:
             "pair_matrix": np.asarray(self.pair_matrix, dtype=np.float64),
             "output_lines": list(self.output_lines),
             "num_draws": int(self.num_draws),
+            "realization_dev": self.realization_dev,
+            "contract_ok": self.contract_ok,
         }
 
     @classmethod
@@ -64,6 +72,8 @@ class AlgorithmRun:
             pair_matrix=np.asarray(payload["pair_matrix"]),
             output_lines=list(payload["output_lines"]),
             num_draws=int(payload.get("num_draws", 0)),
+            realization_dev=payload.get("realization_dev"),
+            contract_ok=payload.get("contract_ok"),
         )
 
 
@@ -133,6 +143,8 @@ def _run_from_distribution(algorithm: str, dist: Distribution, support_eps: floa
         pair_matrix=pair,
         output_lines=list(dist.output_lines),
         num_draws=int(keep.sum()),
+        realization_dev=float(dist.realization_dev),
+        contract_ok=bool(dist.contract_ok),
     )
 
 

@@ -179,6 +179,24 @@ def analyze_instance(
             "selection probability:",
             _percent(frac_below),
         )
+        # realization-contract status of the exact algorithms (ADVICE r5 #1:
+        # a budget-expired rescue ships contract_ok=False and ε-wide
+        # probabilities — the report must say so, not just output_lines)
+        log.log(_RULE)
+        for tag in ("leximin", "xmin"):
+            run = runs[tag]
+            if run.contract_ok is None:
+                continue
+            status = (
+                "satisfied"
+                if run.contract_ok
+                else "MISSED — per-agent probabilities exact only to the stated deviation"
+            )
+            log.log(
+                f"{tag.upper()} realization contract (L-inf <= 1e-3):",
+                f"{status} (max |alloc - certified profile| = "
+                f"{run.realization_dev:.2e})",
+            )
 
         # --- plots (analysis.py:578-619) -------------------------------------
         plots.plot_number_of_panels(

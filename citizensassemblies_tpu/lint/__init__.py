@@ -1,0 +1,35 @@
+"""graftlint — AST-based static analysis for this repo's JAX invariants.
+
+The flagship speedups rest on invariants nothing in the type system enforces:
+jitted cores must stay host-sync-free, jits must be constructed once (not per
+call or per loop iteration), donated buffers must never be read after the
+donating call, the float64 certification arithmetic must not silently
+downcast, Python control flow must not branch on tracers, and every
+``Config`` knob must be genuinely read and documented. graftlint walks the
+package and enforces all of it, with ``file:line`` reports and an explicit
+suppression syntax (``# graftlint: disable=R1 -- reason``).
+
+Run it as ``python -m citizensassemblies_tpu.lint [paths...]`` or via
+``make lint``; the test suite runs the same pass over the real package
+(``tests/test_lint.py``), so a new violation fails tier-1.
+
+The package is deliberately dependency-free (stdlib ``ast`` only — no jax
+import), so linting is fast and runs anywhere, including editors and CI
+runners without an accelerator stack.
+"""
+
+from citizensassemblies_tpu.lint.engine import (
+    LintReport,
+    Violation,
+    all_rules,
+    lint_paths,
+    render_report,
+)
+
+__all__ = [
+    "LintReport",
+    "Violation",
+    "all_rules",
+    "lint_paths",
+    "render_report",
+]

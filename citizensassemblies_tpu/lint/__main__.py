@@ -1,0 +1,5 @@
+import sys
+
+from citizensassemblies_tpu.lint.cli import main
+
+sys.exit(main())

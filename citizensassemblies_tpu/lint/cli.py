@@ -1,0 +1,51 @@
+"""graftlint CLI: ``python -m citizensassemblies_tpu.lint [paths...]``.
+
+Exit code 0 when clean, 1 on violations — pipeline-ready. With no paths the
+package that contains this module is linted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from citizensassemblies_tpu.lint.engine import lint_paths, render_report
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m citizensassemblies_tpu.lint",
+        description=(
+            "graftlint: static analysis of this repo's JAX invariants "
+            "(R1 host-sync-in-jit, R2 jit-per-call, R3 donated-buffer-reuse, "
+            "R4 dtype-discipline, R5 tracer-branch, R6 config-knob-hygiene). "
+            "Suppress with '# graftlint: disable=R1 -- reason'."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files/directories to lint (default: the installed package)",
+    )
+    parser.add_argument(
+        "--readme", type=Path, default=None,
+        help="README checked by R6 (default: nearest README.md above config.py)",
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="print violations only"
+    )
+    args = parser.parse_args(argv)
+
+    paths = args.paths or [Path(__file__).resolve().parent.parent]
+    report = lint_paths(paths, readme=args.readme)
+    rendered = render_report(report)
+    if args.quiet:
+        rendered = "\n".join(v.render() for v in report.violations)
+    if rendered:
+        print(rendered)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""graftlint rule engine: module loading, suppression, rule dispatch.
+
+A rule is an object with ``rule_id`` (``"R1"``), ``name`` (kebab-case slug)
+and ``description``, plus either
+
+* ``check_module(module) -> [Violation]`` — per-file AST rules, or
+* ``check_package(modules) -> [Violation]`` — cross-file rules (R6 needs the
+  whole package plus README to judge a config knob).
+
+Suppression syntax (the acceptance contract requires a *reason*):
+
+* ``# graftlint: disable=R1 -- reason``       suppress R1 on this line and
+  the next (so the comment may sit on its own line above a long statement);
+* ``# graftlint: disable=R1,R4 -- reason``    several rules at once;
+* ``# graftlint: disable-file=R6 -- reason``  whole-file suppression.
+
+A disable comment *without* a reason is itself reported (rule R0) — silent
+suppressions are how invariant checkers rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str  # "R1"
+    name: str  # "host-sync-in-jit"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} [{self.name}] {self.message}"
+
+
+@dataclasses.dataclass
+class ModuleSource:
+    """One parsed python file plus its raw lines (for suppression scanning)."""
+
+    path: Path
+    rel: str  # path as reported in violations
+    text: str
+    lines: List[str]
+    tree: ast.Module
+
+
+@dataclasses.dataclass
+class LintReport:
+    violations: List[Violation]
+    suppressed: int
+    files: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*([A-Z][0-9]+(?:\s*,\s*[A-Z][0-9]+)*)"
+    r"(?:\s*--\s*(\S.*))?"
+)
+
+
+@dataclasses.dataclass
+class _Suppressions:
+    by_line: Dict[int, Set[str]]  # line -> rule ids suppressed there
+    file_wide: Set[str]
+    missing_reason: List[Tuple[int, str]]  # (line, directive) without a reason
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule in self.file_wide:
+            return True
+        rules = self.by_line.get(line)
+        return rules is not None and rule in rules
+
+
+def _parse_suppressions(lines: Sequence[str]) -> _Suppressions:
+    by_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    missing: List[Tuple[int, str]] = []
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        directive, rule_list, reason = m.group(1), m.group(2), m.group(3)
+        rules = {r.strip() for r in rule_list.split(",")}
+        if not reason:
+            missing.append((i, directive))
+        if directive == "disable-file":
+            file_wide |= rules
+        else:
+            # the comment covers its own line and the next one, so it can
+            # annotate a long statement from the line above
+            by_line.setdefault(i, set()).update(rules)
+            by_line.setdefault(i + 1, set()).update(rules)
+    return _Suppressions(by_line=by_line, file_wide=file_wide, missing_reason=missing)
+
+
+def load_module(path: Path, root: Optional[Path] = None) -> Optional[ModuleSource]:
+    """Parse one file; returns None for unparsable sources (reported upstream)."""
+    text = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError:
+        return None
+    try:
+        rel = str(path.relative_to(root)) if root is not None else str(path)
+    except ValueError:
+        rel = str(path)
+    return ModuleSource(
+        path=path, rel=rel, text=text, lines=text.splitlines(), tree=tree
+    )
+
+
+def iter_python_files(paths: Iterable[Path]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    # dedupe, keep order
+    seen: Set[Path] = set()
+    uniq = []
+    for p in out:
+        rp = p.resolve()
+        if rp not in seen:
+            seen.add(rp)
+            uniq.append(p)
+    return uniq
+
+
+def all_rules():
+    """The registered rule set, R1..R6 (R0 is emitted by the engine itself)."""
+    from citizensassemblies_tpu.lint.config_rule import ConfigKnobRule
+    from citizensassemblies_tpu.lint.rules import (
+        DonatedBufferReuseRule,
+        DtypeDisciplineRule,
+        HostSyncInJitRule,
+        JitConstructionRule,
+        TracerBranchRule,
+    )
+
+    return [
+        HostSyncInJitRule(),
+        JitConstructionRule(),
+        DonatedBufferReuseRule(),
+        DtypeDisciplineRule(),
+        TracerBranchRule(),
+        ConfigKnobRule(),
+    ]
+
+
+def lint_paths(
+    paths: Sequence[Path],
+    rules=None,
+    readme: Optional[Path] = None,
+    root: Optional[Path] = None,
+) -> LintReport:
+    """Lint every python file under ``paths`` with the full rule set."""
+    rules = rules if rules is not None else all_rules()
+    root = root or Path.cwd()
+    files = iter_python_files([Path(p) for p in paths])
+    modules: List[ModuleSource] = []
+    raw: List[Violation] = []
+    for f in files:
+        mod = load_module(f, root=root)
+        if mod is None:
+            raw.append(
+                Violation(
+                    path=str(f), line=1, col=0, rule="R0",
+                    name="unparsable", message="file does not parse",
+                )
+            )
+            continue
+        modules.append(mod)
+
+    for rule in rules:
+        if hasattr(rule, "check_package"):
+            raw.extend(rule.check_package(modules, readme=readme))
+        else:
+            for mod in modules:
+                raw.extend(rule.check_module(mod))
+
+    # apply suppressions + report reason-less directives
+    sup_by_rel = {m.rel: _parse_suppressions(m.lines) for m in modules}
+    kept: List[Violation] = []
+    suppressed = 0
+    for v in sorted(raw, key=lambda v: (v.path, v.line, v.col, v.rule)):
+        sup = sup_by_rel.get(v.path)
+        if sup is not None and sup.covers(v.rule, v.line):
+            suppressed += 1
+            continue
+        kept.append(v)
+    for m in modules:
+        for line, directive in sup_by_rel[m.rel].missing_reason:
+            kept.append(
+                Violation(
+                    path=m.rel, line=line, col=0, rule="R0",
+                    name="suppression-without-reason",
+                    message=(
+                        f"'graftlint: {directive}=' needs a reason "
+                        "(append ' -- why this is safe')"
+                    ),
+                )
+            )
+    kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return LintReport(violations=kept, suppressed=suppressed, files=len(files))
+
+
+def render_report(report: LintReport) -> str:
+    lines = [v.render() for v in report.violations]
+    tail = (
+        f"graftlint: {len(report.violations)} violation(s), "
+        f"{report.suppressed} suppressed, {report.files} file(s) checked"
+    )
+    return "\n".join(lines + [tail])

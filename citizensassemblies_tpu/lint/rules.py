@@ -1,0 +1,690 @@
+"""graftlint rules R1–R5: per-module AST analyses of the JAX invariants.
+
+Each rule is small and self-contained; shared helpers (dotted-name
+resolution, jit-decorator parsing, parent maps) live at the top. The rules
+are deliberately *heuristic where they must be* (static reachability, memo
+detection) and written so that every false positive has an explicit escape:
+``# graftlint: disable=Rn -- reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from citizensassemblies_tpu.lint.engine import ModuleSource, Violation
+
+# --- shared helpers ---------------------------------------------------------
+
+#: bare / dotted names that construct a jit-compiled callable
+_JIT_NAMES = {"jit", "pjit", "pmap"}
+_JIT_DOTTED_SUFFIXES = ("shard_map", "shard_map_compat")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """Is this expression a reference to jit/pjit/pmap/shard_map itself?"""
+    d = dotted(node)
+    if d is None:
+        return False
+    last = d.rsplit(".", 1)[-1]
+    return last in _JIT_NAMES or any(d.endswith(s) for s in _JIT_DOTTED_SUFFIXES)
+
+
+def _is_partial_ref(node: ast.AST) -> bool:
+    d = dotted(node)
+    return d is not None and d.rsplit(".", 1)[-1] == "partial"
+
+
+def jit_construction(node: ast.AST) -> Optional[ast.Call]:
+    """The Call that constructs a jitted callable, if ``node`` is one.
+
+    Matches ``jax.jit(...)``, ``jit(...)``, ``partial(jax.jit, ...)`` and
+    the shard_map variants. Returns the Call whose keywords carry
+    static/donate metadata (the partial call for the partial form).
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_ref(node.func):
+        return node
+    if _is_partial_ref(node.func) and node.args and _is_jit_ref(node.args[0]):
+        return node
+    return None
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for elt in node.elts:
+            out.extend(_const_strs(elt))
+        return out
+    return []
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for elt in node.elts:
+            out.extend(_const_ints(elt))
+        return out
+    return []
+
+
+@dataclasses.dataclass
+class JitMeta:
+    """Parsed jit construction: static/donated argument metadata."""
+
+    static_names: Set[str]
+    static_nums: Set[int]
+    donate_nums: Set[int]
+
+
+def parse_jit_meta(call: ast.Call) -> JitMeta:
+    static_names: Set[str] = set()
+    static_nums: Set[int] = set()
+    donate_nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            static_names.update(_const_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            static_nums.update(_const_ints(kw.value))
+        elif kw.arg == "donate_argnums":
+            donate_nums.update(_const_ints(kw.value))
+    return JitMeta(static_names, static_nums, donate_nums)
+
+
+def jit_decorator_meta(fn: ast.AST) -> Optional[JitMeta]:
+    """JitMeta when ``fn`` is decorated by a jit construction (else None)."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for dec in fn.decorator_list:
+        if _is_jit_ref(dec):
+            return JitMeta(set(), set(), set())
+        call = jit_construction(dec)
+        if call is not None:
+            return parse_jit_meta(call)
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing(node: ast.AST, parents, kinds) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def numpy_aliases(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to the ``numpy`` module (``np`` usually)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "numpy":
+                    out.add(alias.asname or "numpy")
+    return out
+
+
+def jnp_aliases(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.numpy":
+                    out.add(alias.asname or "jax.numpy")
+    return out
+
+
+def positional_params(fn: ast.FunctionDef) -> List[str]:
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+# --- R1: host syncs reachable from jitted code ------------------------------
+
+
+class HostSyncInJitRule:
+    """R1 — host-synchronizing calls inside functions reachable from
+    ``jit``/``shard_map``-decorated code.
+
+    ``.item()``, ``.tolist()``, ``.block_until_ready()``, ``np.asarray`` /
+    ``np.array``, ``jax.device_get`` and ``float()/int()/bool()`` on
+    non-literal operands all force a device→host sync (or fail outright on a
+    tracer); none belong anywhere a jitted core can reach. Reachability is
+    the transitive closure over same-module calls-by-name starting from
+    every jit/shard_map-decorated function (nested defs and lambdas are
+    scanned as part of their parent's subtree).
+    """
+
+    rule_id = "R1"
+    name = "host-sync-in-jit"
+    description = "host-sync call reachable from jitted code"
+
+    _SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+    _NP_SYNC_FUNCS = {"asarray", "array", "copy", "save"}
+    _CAST_BUILTINS = {"float", "int", "bool"}
+
+    def check_module(self, mod: ModuleSource) -> List[Violation]:
+        tree = mod.tree
+        np_alias = numpy_aliases(tree)
+
+        # module-level function table (for reachability resolution)
+        table: Dict[str, ast.FunctionDef] = {
+            node.name: node
+            for node in tree.body
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+        # roots: decorated functions anywhere + functions wrapped by name
+        roots: List[ast.FunctionDef] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if jit_decorator_meta(node) is not None:
+                    roots.append(node)
+            call = jit_construction(node)
+            if call is not None:
+                # jax.jit(f) / partial(jax.jit)(f): resolve a Name operand
+                operands = call.args[1:] if _is_partial_ref(call.func) else call.args
+                for arg in operands:
+                    if isinstance(arg, ast.Name) and arg.id in table:
+                        roots.append(table[arg.id])
+
+        # transitive closure over same-module calls by bare name
+        reachable: List[ast.FunctionDef] = []
+        seen: Set[ast.AST] = set()
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            reachable.append(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    target = table.get(node.func.id)
+                    if target is not None and target not in seen:
+                        work.append(target)
+
+        out: List[Violation] = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            out.append(
+                Violation(
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    rule=self.rule_id, name=self.name,
+                    message=f"{what} forces a host sync inside jit-reachable code",
+                )
+            )
+
+        flagged: Set[Tuple[int, int]] = set()
+        for fn in reachable:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in flagged:
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute) and func.attr in self._SYNC_ATTRS:
+                    flagged.add(key)
+                    flag(node, f".{func.attr}()")
+                    continue
+                d = dotted(func)
+                if d is not None:
+                    head, _, last = d.rpartition(".")
+                    if head in np_alias and last in self._NP_SYNC_FUNCS:
+                        flagged.add(key)
+                        flag(node, f"{d}()")
+                        continue
+                    if d.endswith("device_get"):
+                        flagged.add(key)
+                        flag(node, f"{d}()")
+                        continue
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id in self._CAST_BUILTINS
+                    and node.args
+                    and not isinstance(node.args[0], ast.Constant)
+                ):
+                    flagged.add(key)
+                    flag(node, f"{func.id}() on a non-literal")
+        return out
+
+
+# --- R2: jit constructed per call / inside loops ----------------------------
+
+
+class JitConstructionRule:
+    """R2 — ``jax.jit`` constructed inside a loop or per call.
+
+    Every fresh ``jax.jit(f)`` object owns a fresh compilation cache, so
+    constructing one per call (or per loop iteration) recompiles the same
+    program forever. jits must be module-level, decorators on module-level
+    functions, or memoized — a function-local construction is accepted only
+    when the enclosing function shows a memo pattern (a ``global`` statement,
+    or a store into a module-level cache dict/attribute), which is how
+    ``face_decompose._get_move_screen_core`` and ``parallel.solver._run_core``
+    cache their compiled cores, or when the enclosing function is a *factory*
+    that returns the constructed callable (``mesh.shard_map_compat``) — the
+    per-call judgement then falls on the factory's call sites, which are
+    themselves jit constructions to this rule.
+    """
+
+    rule_id = "R2"
+    name = "jit-per-call"
+    description = "jit constructed inside a loop or per call"
+
+    def check_module(self, mod: ModuleSource) -> List[Violation]:
+        tree = mod.tree
+        parents = parent_map(tree)
+        module_names: Set[str] = {
+            t.id
+            for node in tree.body
+            if isinstance(node, ast.Assign)
+            for t in node.targets
+            if isinstance(t, ast.Name)
+        } | {
+            node.target.id
+            for node in tree.body
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name)
+        }
+
+        def is_factory(fn: ast.AST, constructed: ast.AST, anchor: ast.AST) -> bool:
+            """The enclosing function returns the constructed callable —
+            directly, via a local name it was bound to, or via the name of
+            the decorated nested function."""
+            bound: Set[str] = set()
+            if isinstance(anchor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(anchor.name)
+            assign = parents.get(constructed)
+            if isinstance(assign, ast.Assign):
+                bound.update(
+                    t.id for t in assign.targets if isinstance(t, ast.Name)
+                )
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    if node.value is constructed:
+                        return True
+                    if isinstance(node.value, ast.Name) and node.value.id in bound:
+                        return True
+            return False
+
+        def has_memo_pattern(fn: ast.AST) -> bool:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    return True
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in module_names
+                        ):
+                            return True
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in module_names
+                        ):
+                            return True
+            return False
+
+        out: List[Violation] = []
+        for node in ast.walk(tree):
+            call = jit_construction(node)
+            if call is None or call is not node:
+                continue
+            loop = enclosing(node, parents, (ast.For, ast.While, ast.AsyncFor))
+            if loop is not None:
+                out.append(
+                    Violation(
+                        path=mod.rel, line=node.lineno, col=node.col_offset,
+                        rule=self.rule_id, name=self.name,
+                        message=(
+                            "jit constructed inside a loop — every iteration "
+                            "compiles from scratch; hoist it to module level "
+                            "or memoize"
+                        ),
+                    )
+                )
+                continue
+            # decorator? judge by the *decorated function's* nesting level
+            anchor = node
+            parent = parents.get(node)
+            if (
+                isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node in parent.decorator_list
+            ):
+                anchor = parent
+            fn = enclosing(anchor, parents, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if fn is None:
+                continue  # module level (incl. decorators on top-level defs)
+            if has_memo_pattern(fn) or is_factory(fn, node, anchor):
+                continue
+            out.append(
+                Violation(
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    rule=self.rule_id, name=self.name,
+                    message=(
+                        f"jit constructed per call of '{getattr(fn, 'name', '?')}' "
+                        "with no visible memoization — hoist to module level "
+                        "or cache the compiled callable"
+                    ),
+                )
+            )
+        return out
+
+
+# --- R3: donated buffers read after the donating call -----------------------
+
+
+class DonatedBufferReuseRule:
+    """R3 — a donated argument read after its ``donate_argnums`` call site.
+
+    Donation hands the input buffer to XLA for reuse; reading the python
+    binding afterwards returns a deleted array (on accelerators) or silently
+    stale data. The rule collects every jitted callable with
+    ``donate_argnums`` (decorator or ``x = jax.jit(f, donate_argnums=...)``
+    form), then flags loads of a donated Name argument after the call,
+    stopping at rebinds.
+    """
+
+    rule_id = "R3"
+    name = "donated-buffer-reuse"
+    description = "donated buffer read after the donating call"
+
+    def check_package(self, modules: Sequence[ModuleSource], readme=None) -> List[Violation]:
+        # pass 1: package-wide donor table, bare-name keyed
+        donors: Dict[str, Set[int]] = {}
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    meta = jit_decorator_meta(node)
+                    if meta is not None and meta.donate_nums:
+                        donors[node.name] = meta.donate_nums
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    call = jit_construction(node.value)
+                    if isinstance(t, ast.Name) and call is not None:
+                        meta = parse_jit_meta(call)
+                        if meta.donate_nums:
+                            donors[t.id] = meta.donate_nums
+
+        out: List[Violation] = []
+        for mod in modules:
+            out.extend(self._check_calls(mod, donors))
+        return out
+
+    def _check_calls(self, mod: ModuleSource, donors: Dict[str, Set[int]]) -> List[Violation]:
+        parents = parent_map(mod.tree)
+        out: List[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+                continue
+            donated = donors.get(node.func.id)
+            if not donated:
+                continue
+            donated_names = {
+                node.args[i].id
+                for i in donated
+                if i < len(node.args) and isinstance(node.args[i], ast.Name)
+            }
+            if not donated_names:
+                continue
+            fn = enclosing(node, parents, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if fn is None:
+                continue
+            # the statement containing the call: its assignment targets are
+            # rebinds that happen AFTER the call evaluates
+            stmt = enclosing(node, parents, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+            rebound_by_stmt: Set[str] = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, (ast.Name,)) and isinstance(n.ctx, ast.Store):
+                            rebound_by_stmt.add(n.id)
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        pass  # names collected above
+            call_end = (node.end_lineno or node.lineno, node.end_col_offset or 0)
+            live = set(donated_names) - rebound_by_stmt
+            refs = sorted(
+                (
+                    ((n.lineno, n.col_offset), n)
+                    for n in ast.walk(fn)
+                    if isinstance(n, ast.Name) and n.id in donated_names
+                ),
+                key=lambda kv: kv[0],
+            )
+            for pos, ref in refs:
+                if pos <= call_end:
+                    continue
+                if ref.id not in live:
+                    continue
+                if isinstance(ref.ctx, ast.Store):
+                    live.discard(ref.id)
+                    continue
+                out.append(
+                    Violation(
+                        path=mod.rel, line=ref.lineno, col=ref.col_offset,
+                        rule=self.rule_id, name=self.name,
+                        message=(
+                            f"'{ref.id}' was donated to '{node.func.id}' at "
+                            f"line {node.lineno} and read afterwards — the "
+                            "buffer belongs to XLA now"
+                        ),
+                    )
+                )
+                live.discard(ref.id)
+        return out
+
+
+# --- R4: dtype discipline ---------------------------------------------------
+
+
+class DtypeDisciplineRule:
+    """R4 — float64 only in the x64-enabled certification paths, and no
+    float32 downcasts inside them.
+
+    ``jax_enable_x64`` is off everywhere in this stack, so a ``jnp.float64``
+    request outside the host-side float64 paths silently materializes
+    float32 — the worst kind of precision bug, invisible until a
+    certification threshold flips. Conversely the certification modules
+    (``solvers/lp_util.py``, ``solvers/compositions.py``) do their residual
+    arithmetic in float64 numpy on host, and a float32 cast there quietly
+    downgrades an accept-threshold comparison.
+    """
+
+    rule_id = "R4"
+    name = "dtype-discipline"
+    description = "float64/float32 discipline of the certification paths"
+
+    _F64_WHITELIST = ("solvers/lp_util.py", "solvers/compositions.py")
+
+    def check_module(self, mod: ModuleSource) -> List[Violation]:
+        jnp = jnp_aliases(mod.tree)
+        np_alias = numpy_aliases(mod.tree)
+        in_whitelist = any(mod.rel.endswith(w) for w in self._F64_WHITELIST)
+        out: List[Violation] = []
+
+        def viol(node: ast.AST, msg: str) -> None:
+            out.append(
+                Violation(
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    rule=self.rule_id, name=self.name, message=msg,
+                )
+            )
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                base = dotted(node.value)
+                if node.attr == "float64" and base in jnp and not in_whitelist:
+                    viol(
+                        node,
+                        "jnp.float64 outside the x64-enabled certification "
+                        "paths silently materializes float32 (x64 is "
+                        "disabled) — use float32 explicitly or move the "
+                        "arithmetic to the host float64 path",
+                    )
+                if (
+                    node.attr == "float32"
+                    and in_whitelist
+                    and base is not None
+                    and (base in np_alias or base in jnp)
+                ):
+                    viol(
+                        node,
+                        "float32 cast inside the float64 certification path "
+                        "— the residual/threshold arithmetic must stay "
+                        "float64",
+                    )
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value == "float64"
+                        and not in_whitelist
+                    ):
+                        d = dotted(node.func) or ""
+                        if d.split(".", 1)[0] in jnp:
+                            viol(
+                                node,
+                                'dtype="float64" on a jnp call outside the '
+                                "certification paths silently materializes "
+                                "float32",
+                            )
+        return out
+
+
+# --- R5: tracer branching & static-arg hygiene ------------------------------
+
+
+class TracerBranchRule:
+    """R5 — Python ``if``/``while`` on tracer values, and unhashable values
+    passed for static arguments.
+
+    Inside a jitted function, branching on a non-static parameter either
+    fails at trace time (ConcretizationTypeError) or — worse — got baked in
+    at trace time by accident. ``is None`` / ``is not None`` tests are
+    exempt (argument-presence dispatch resolves at trace time). The second
+    half checks call sites of known jitted callables: a list/dict/set
+    literal passed for a ``static_argnames`` parameter is unhashable and
+    fails the jit cache lookup.
+    """
+
+    rule_id = "R5"
+    name = "tracer-branch"
+    description = "python branching on tracers / unhashable statics"
+
+    def check_package(self, modules: Sequence[ModuleSource], readme=None) -> List[Violation]:
+        # package-wide table of jitted callables' static names
+        statics: Dict[str, Set[str]] = {}
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    meta = jit_decorator_meta(node)
+                    if meta is not None and meta.static_names:
+                        statics[node.name] = meta.static_names
+        out: List[Violation] = []
+        for mod in modules:
+            out.extend(self._check_module(mod, statics))
+        return out
+
+    @staticmethod
+    def _is_none_test(test: ast.AST) -> bool:
+        """True when the test resolves at trace time: pure is/is-not
+        comparisons, possibly combined with and/or/not."""
+        if isinstance(test, ast.BoolOp):
+            return all(TracerBranchRule._is_none_test(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return TracerBranchRule._is_none_test(test.operand)
+        if isinstance(test, ast.Compare):
+            return all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+        return False
+
+    def _check_module(self, mod: ModuleSource, statics: Dict[str, Set[str]]) -> List[Violation]:
+        out: List[Violation] = []
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            meta = jit_decorator_meta(fn)
+            if meta is None:
+                continue
+            params = positional_params(fn)
+            traced = {
+                p
+                for i, p in enumerate(params)
+                if p not in meta.static_names and i not in meta.static_nums
+            }
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if self._is_none_test(node.test):
+                    continue
+                names = {
+                    n.id
+                    for n in ast.walk(node.test)
+                    if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                }
+                hot = sorted(names & traced)
+                if hot:
+                    out.append(
+                        Violation(
+                            path=mod.rel, line=node.lineno, col=node.col_offset,
+                            rule=self.rule_id, name=self.name,
+                            message=(
+                                f"python {'if' if isinstance(node, ast.If) else 'while'} "
+                                f"branches on traced argument(s) {', '.join(hot)} "
+                                f"of jitted '{fn.name}' — use lax.cond/select "
+                                "or mark the argument static"
+                            ),
+                        )
+                    )
+        # unhashable values at static call sites
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+                continue
+            static_names = statics.get(node.func.id)
+            if not static_names:
+                continue
+            for kw in node.keywords:
+                if kw.arg in static_names and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set)
+                ):
+                    out.append(
+                        Violation(
+                            path=mod.rel, line=kw.value.lineno, col=kw.value.col_offset,
+                            rule=self.rule_id, name=self.name,
+                            message=(
+                                f"unhashable literal for static argument "
+                                f"'{kw.arg}' of jitted '{node.func.id}' — "
+                                "static values must be hashable (tuple, str, "
+                                "int)"
+                            ),
+                        )
+                    )
+        return out

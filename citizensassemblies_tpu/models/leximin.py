@@ -322,7 +322,7 @@ def _typespace_leximin(
                 # at the default config; derived from cfg so the knobs
                 # cannot silently drift past the contract).
                 tol=max(
-                    1e-6 if comps is not None else 2e-5,
+                    cfg.decomp_tol if comps is not None else max(cfg.decomp_tol, 2e-5),
                     min(
                         max(
                             0.5 * getattr(ts, "eps_dev", 0.0),
@@ -530,9 +530,18 @@ def find_distribution_leximin(
         if deadline is None or _time.monotonic() <= deadline:
             return None
         # ship the certified-profile fallback with an explicit ε statement;
-        # append only log lines the fallback snapshot does not already hold
-        # (its output_lines were initialized from this same RunLog)
-        ts_fallback.output_lines.extend(log.lines[len(ts_fallback.output_lines):])
+        # append only log lines the fallback snapshot does not already hold.
+        # Today both type-space paths share this RunLog, so the snapshot is a
+        # strict prefix of log.lines — but that is an invariant of the
+        # CURRENT construction, not of the Distribution contract, so the
+        # splice is guarded (ADVICE r5 #4): a fallback built from a different
+        # RunLog gets its lines REBUILT from the live log outright instead of
+        # silently splicing duplicated or misaligned lines into the record.
+        prefix = ts_fallback.output_lines
+        if log.lines[: len(prefix)] == prefix:
+            prefix.extend(log.lines[len(prefix):])
+        else:
+            ts_fallback.output_lines = list(log.lines)
         msg = (
             f"Agent-space CG exceeded its {cfg.agent_space_budget_s:.0f} s "
             f"budget with {int((fixed >= 0).sum())}/{n} probabilities "
@@ -543,12 +552,20 @@ def find_distribution_leximin(
         )
         log.emit(msg)
         ts_fallback.output_lines.append(msg)
-        # the run COMPLETED (with an explicit ε-wide result): leaving the
-        # agent-space checkpoint behind would make an identical rerun skip
-        # the type-space solve (no fallback ⇒ no deadline) and grind the
-        # unbudgeted multi-hour CG this budget exists to prevent
+        # the agent-space CG's partial progress is resumable state, not
+        # garbage: the checkpoint is PRESERVED (ADVICE r5 #1) so an explicit
+        # rerun against the same checkpoint path resumes the exact CG where
+        # it stopped — a resumed run skips the type-space solve, has no
+        # fallback and hence no budget, which is then the caller's stated
+        # choice rather than an accidental multi-hour grind
         if checkpoint_path is not None:
-            clear_cg_state(checkpoint_path)
+            resume_msg = (
+                f"Agent-space CG checkpoint preserved at {checkpoint_path}; "
+                f"rerunning with the same checkpoint path resumes the exact "
+                f"CG (unbudgeted) instead of re-deriving this fallback."
+            )
+            log.emit(resume_msg)
+            ts_fallback.output_lines.append(resume_msg)
         return ts_fallback
 
     while (fixed < 0).any():
@@ -630,8 +647,11 @@ def find_distribution_leximin(
                 continue
 
             # fast path: batched stochastic pricing; add several violated
-            # columns per LP solve
-            if stochastic_fails < 2:
+            # columns per LP solve. Past cfg.max_portfolio the batch adds
+            # stop and the exact oracle carries the tail one certified
+            # column per round (the reference's loop shape), so the padded
+            # dual-LP buffer stays bounded.
+            if stochastic_fails < 2 and len(portfolio) < cfg.max_portfolio:
                 key, sub = jax.random.split(key)
                 with log.timer("stochastic_pricing"):
                     panels, values, ok = stochastic_price(

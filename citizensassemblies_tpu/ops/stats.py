@@ -74,6 +74,7 @@ def share_below(alloc: jnp.ndarray, threshold: jnp.ndarray) -> jnp.ndarray:
 def prob_allocation_stats(alloc, cap_for_geometric_mean: bool) -> ProbAllocationStats:
     """Host-facing bundle matching ``compute_prob_allocation_stats``
     (``analysis.py:231-255``)."""
+    # graftlint: disable=R4 -- f64 only when jax_enable_x64 is on; else explicit f32
     alloc = jnp.asarray(alloc, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
     return ProbAllocationStats(
         gini=float(gini(alloc)),

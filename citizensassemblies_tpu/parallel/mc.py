@@ -7,6 +7,14 @@ the per-agent selection counts plus the n×n pair co-selection matrix are
 reduced with ``psum`` over the ``chains`` axis (ICI collectives — the
 framework's "communication backend", cf. SURVEY.md §5 "Distributed
 communication backend").
+
+The shard_map'd callables are built once per (mesh, static-shape) key and
+memoized in module-level caches: a fresh wrapper per call carries a fresh
+trace identity, which defeats JAX's compile cache and re-lowers the whole
+sampler every MC round (graftlint R2). The instance tensors are therefore
+*arguments* of the mapped functions (replicated specs), not closure captures
+— captured device arrays would be baked into the trace as constants, forcing
+exactly the per-call retrace the memo exists to avoid.
 """
 
 from __future__ import annotations
@@ -22,6 +30,38 @@ from jax.sharding import PartitionSpec as P
 from citizensassemblies_tpu.core.instance import DenseInstance
 from citizensassemblies_tpu.models.legacy import _sample_panels_kernel, chain_keys_for
 from citizensassemblies_tpu.parallel.mesh import shard_map_compat
+
+_DRAW_CACHE: dict = {}
+_ROUND_CACHE: dict = {}
+_MATVEC_CACHE: dict = {}
+
+
+def _draw_callable(mesh: Mesh, B_local: int, sharded_scores: bool):
+    """Memoized chain-parallel draw: args ``(dense, keys, scores, households)``
+    with the instance replicated and the key/score streams chain-sharded."""
+    key = (mesh, B_local, sharded_scores)
+    fn = _DRAW_CACHE.get(key)
+    if fn is None:
+        score_spec = P(("chains", "agents")) if sharded_scores else P()
+
+        @partial(
+            shard_map_compat,
+            mesh=mesh,
+            in_specs=(P(), P(("chains", "agents")), score_spec, P()),
+            out_specs=(P(("chains", "agents")), P(("chains", "agents"))),
+        )
+        def fn(dense, local_keys, local_scores, households):
+            return _sample_panels_kernel(
+                dense,
+                local_keys[0],
+                B_local,
+                local_scores,
+                households,
+                chain_keys=local_keys,
+            )
+
+        _DRAW_CACHE[key] = fn
+    return fn
 
 
 def distributed_sample_panels(
@@ -46,35 +86,58 @@ def distributed_sample_panels(
     B_local = -(-batch // ndev)  # ceil
     total = B_local * ndev
     keys = chain_keys_for(key, 0, total)
-    if scores is not None and getattr(scores, "ndim", 1) == 2 and scores.shape[0] > 1:
-        if scores.shape[0] < total:
-            scores = jnp.concatenate(
-                [jnp.asarray(scores, jnp.float32)]
-                + [jnp.zeros((total - scores.shape[0], dense.n), jnp.float32)],
-                axis=0,
-            )
-        score_spec = P(("chains", "agents"))
-    else:
-        score_spec = P()
-
-    @partial(
-        shard_map_compat,
-        mesh=mesh,
-        in_specs=(P(("chains", "agents")), score_spec),
-        out_specs=(P(("chains", "agents")), P(("chains", "agents"))),
+    sharded_scores = (
+        scores is not None and getattr(scores, "ndim", 1) == 2 and scores.shape[0] > 1
     )
-    def draw(local_keys, local_scores):
-        return _sample_panels_kernel(
-            dense,
-            local_keys[0],
-            B_local,
-            local_scores,
-            households,
-            chain_keys=local_keys,
+    if sharded_scores and scores.shape[0] < total:
+        scores = jnp.concatenate(
+            [jnp.asarray(scores, jnp.float32)]
+            + [jnp.zeros((total - scores.shape[0], dense.n), jnp.float32)],
+            axis=0,
         )
-
-    panels, ok = draw(keys, scores if scores is not None else jnp.zeros((1, dense.n), jnp.float32))
+    # a singleton-household vector is the kernel's households=None semantics,
+    # so the mapped function keeps one signature either way
+    hh = (
+        jnp.asarray(households, jnp.int32)
+        if households is not None
+        else jnp.arange(dense.n, dtype=jnp.int32)
+    )
+    draw = _draw_callable(mesh, B_local, sharded_scores)
+    panels, ok = draw(
+        dense,
+        keys,
+        scores if scores is not None else jnp.zeros((1, dense.n), jnp.float32),
+        hh,
+    )
     return panels[:batch], ok[:batch]
+
+
+def _round_callable(mesh: Mesh, per_device_batch: int, n: int):
+    """Memoized MC round: one draw + psum-reduced count/pair statistics."""
+    key = (mesh, per_device_batch, n)
+    fn = _ROUND_CACHE.get(key)
+    if fn is None:
+        # varying-axis audit off (shard_map_compat): the sampler's scan
+        # carries state replicated that becomes device-varying through the
+        # per-device keys
+        @partial(
+            shard_map_compat,
+            mesh=mesh,
+            in_specs=(P(), P(("chains", "agents"))),
+            out_specs=(P(("chains", "agents")), P(("chains", "agents")), P(), P()),
+        )
+        def fn(dense, local_keys):
+            panels, ok = _sample_panels_kernel(dense, local_keys[0], per_device_batch)
+            S = jnp.zeros((per_device_batch, n), dtype=jnp.float32)
+            S = S.at[jnp.arange(per_device_batch)[:, None], panels].set(1.0)
+            S = S * ok[:, None].astype(jnp.float32)
+            counts = jax.lax.psum(jnp.sum(S, axis=0), ("chains", "agents"))
+            pair = jax.lax.psum(S.T @ S, ("chains", "agents"))
+            pair = pair * (1.0 - jnp.eye(n, dtype=pair.dtype))
+            return panels, ok, counts, pair
+
+        _ROUND_CACHE[key] = fn
+    return fn
 
 
 def distributed_mc_round(
@@ -87,29 +150,28 @@ def distributed_mc_round(
     ``counts``/``pair`` are the psum-reduced selection counts and pair
     co-selection counts of all accepted panels.
     """
-    n = dense.n
     ndev = mesh.devices.size
     keys = jax.random.split(key, ndev)
+    round_fn = _round_callable(mesh, per_device_batch, dense.n)
+    return round_fn(dense, keys)
 
-    # varying-axis audit off (shard_map_compat): the sampler's scan carries
-    # state replicated that becomes device-varying through the per-device keys
-    @partial(
-        shard_map_compat,
-        mesh=mesh,
-        in_specs=P(("chains", "agents")),
-        out_specs=(P(("chains", "agents")), P(("chains", "agents")), P(), P()),
-    )
-    def round_fn(local_keys):
-        panels, ok = _sample_panels_kernel(dense, local_keys[0], per_device_batch)
-        S = jnp.zeros((per_device_batch, n), dtype=jnp.float32)
-        S = S.at[jnp.arange(per_device_batch)[:, None], panels].set(1.0)
-        S = S * ok[:, None].astype(jnp.float32)
-        counts = jax.lax.psum(jnp.sum(S, axis=0), ("chains", "agents"))
-        pair = jax.lax.psum(S.T @ S, ("chains", "agents"))
-        pair = pair * (1.0 - jnp.eye(n, dtype=pair.dtype))
-        return panels, ok, counts, pair
 
-    return round_fn(keys)
+def _matvec_callable(mesh: Mesh):
+    key = mesh
+    fn = _MATVEC_CACHE.get(key)
+    if fn is None:
+
+        @partial(
+            shard_map_compat,
+            mesh=mesh,
+            in_specs=(P("chains", "agents"), P("chains")),
+            out_specs=P("agents"),
+        )
+        def fn(P_local, p_local):
+            return jax.lax.psum(P_local.T @ p_local, "chains")
+
+        _MATVEC_CACHE[key] = fn
+    return fn
 
 
 def distributed_allocation(P_matrix, probs, mesh: Mesh):
@@ -118,14 +180,4 @@ def distributed_allocation(P_matrix, probs, mesh: Mesh):
     solver at large portfolio sizes."""
     P_sharded = jax.device_put(P_matrix, NamedSharding(mesh, P("chains", "agents")))
     p_sharded = jax.device_put(probs, NamedSharding(mesh, P("chains")))
-
-    @partial(
-        shard_map_compat,
-        mesh=mesh,
-        in_specs=(P("chains", "agents"), P("chains")),
-        out_specs=P("agents"),
-    )
-    def matvec(P_local, p_local):
-        return jax.lax.psum(P_local.T @ p_local, "chains")
-
-    return matvec(P_sharded, p_sharded)
+    return _matvec_callable(mesh)(P_sharded, p_sharded)

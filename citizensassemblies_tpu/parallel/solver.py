@@ -183,6 +183,7 @@ def _run_core(
     tol: float,
     block_iters: int,
     max_blocks: int,
+    cfg: Optional[Config] = None,
 ):
     """Shared marshalling for the sharded PDHG core: cache the COMPILED
     program per (mesh, block schedule), upload the row shards pre-partitioned,
@@ -208,14 +209,17 @@ def _run_core(
     rep_sharding = NamedSharding(mesh, P())
     G_dev = jax.device_put(np.asarray(G, np.float32), row_sharding)
     h_dev = jax.device_put(np.asarray(h, np.float32), vec_sharding)
-    return core(
-        G_dev,
-        h_dev,
-        jax.device_put(np.asarray(c, np.float32), rep_sharding),
-        jax.device_put(np.asarray(a_row, np.float32), rep_sharding),
-        jax.device_put(np.asarray(b, np.float32), rep_sharding),
-        jax.device_put(np.asarray([tol], np.float32), rep_sharding),
-    )
+    c_dev = jax.device_put(np.asarray(c, np.float32), rep_sharding)
+    a_dev = jax.device_put(np.asarray(a_row, np.float32), rep_sharding)
+    b_dev = jax.device_put(np.asarray(b, np.float32), rep_sharding)
+    tol_dev = jax.device_put(np.asarray([tol], np.float32), rep_sharding)
+    # every input arrives pre-partitioned via explicit device_put above; the
+    # guard makes an IMPLICIT transfer inside the sharded solve an error —
+    # exactly the per-round host-side re-layout this path exists to avoid
+    from citizensassemblies_tpu.utils.guards import no_implicit_transfers
+
+    with no_implicit_transfers(cfg):
+        return core(G_dev, h_dev, c_dev, a_dev, b_dev, tol_dev)
 
 
 def solve_dual_lp_pdhg_sharded(
@@ -254,7 +258,7 @@ def solve_dual_lp_pdhg_sharded(
 
     x, lam, mu, res = _run_core(
         mesh, G, np.zeros(rows, dtype=np.float32), c, a_row, b, tol,
-        block_iters, max_blocks,
+        block_iters, max_blocks, cfg=cfg,
     )
     x = np.asarray(x, dtype=np.float64)
     res_f = float(np.asarray(res)[0])
@@ -313,7 +317,7 @@ def solve_decomp_master_sharded(
     c[C] = 1.0
 
     x, lam, mu, res = _run_core(
-        mesh, G, h, c, a_row, b, tol, block_iters, max_blocks
+        mesh, G, h, c, a_row, b, tol, block_iters, max_blocks, cfg=cfg
     )
     x = np.asarray(x, dtype=np.float64)
     lam = np.asarray(lam, dtype=np.float64)

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
 from citizensassemblies_tpu.utils.logging import RunLog
 
 
@@ -161,6 +162,7 @@ def _batched_move_screen(
     tj: np.ndarray,
     packed,
     per_round_cap: int,
+    cfg=None,
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host marshalling for the jitted move screen: pad to the screening
     buckets, split the uint64 need-masks into uint32 lanes, decode the
@@ -213,13 +215,22 @@ def _batched_move_screen(
     )
 
     core = _get_move_screen_core()
-    idx, total = core(
-        comps_p, counts_nb, lo_nb, hi_nb, counts_full,
-        lo.astype(np.int32), hi.astype(np.int32),
-        np.asarray(m, np.int32), ti_p, tj_p, valid,
-        ns_lo, ns_hi, na_lo, na_hi, lf_ai, lf_aj, lf_donor,
-        cap=int(per_round_cap),
+    import jax.numpy as jnp
+
+    # the screen's operands change every round, so the upload is inherent —
+    # but it is made EXPLICIT here (one jnp.asarray per operand), and the
+    # guard then rejects any further implicit transfer inside the jitted call
+    operands = tuple(
+        jnp.asarray(a)
+        for a in (
+            comps_p, counts_nb, lo_nb, hi_nb, counts_full,
+            lo.astype(np.int32), hi.astype(np.int32),
+            np.asarray(m, np.int32), ti_p, tj_p, valid,
+            ns_lo, ns_hi, na_lo, na_hi, lf_ai, lf_aj, lf_donor,
+        )
     )
+    with no_implicit_transfers(cfg):
+        idx, total = core(*operands, cap=int(per_round_cap))
     idx = np.asarray(idx)
     idx = idx[idx >= 0]
     return idx // Pp, idx % Pp, int(total)
@@ -238,6 +249,7 @@ def neighbor_columns(
     face_pairs: int = 12_288,
     per_round_cap: int = 16_384,
     batched: bool = False,
+    cfg=None,
 ) -> np.ndarray:
     """Feasible single-unit moves from ``comps`` along and across the face.
 
@@ -311,7 +323,7 @@ def neighbor_columns(
     packed = _feature_bitmasks(reduction)
     if batched and packed is not None and S <= _SCREEN_ROWS:
         si, pi, _total = _batched_move_screen(
-            comps, counts, reduction, m, ti, tj, packed, per_round_cap
+            comps, counts, reduction, m, ti, tj, packed, per_round_cap, cfg=cfg
         )
         if len(si) == 0:
             return np.zeros((0, T), dtype=np.int16)
@@ -770,6 +782,14 @@ def realize_profile(
                 added += add(batch[i])
         return added
 
+    # compilation counter over the whole face loop: the padded buckets exist
+    # so CG rounds re-enter compiled executables — the count lands in the
+    # phase counters (xla_compiles_decomp) where a per-round recompile would
+    # be immediately visible next to the warm-start/overlap attribution
+    from contextlib import ExitStack
+
+    _guards = ExitStack()
+    _guards.enter_context(CompilationGuard("decomp", log=log))
     try:
         for rnd in range(max_rounds):
             t_round = time.time()
@@ -958,7 +978,7 @@ def realize_profile(
                     cand.append(
                         neighbor_columns(
                             np.stack(kept[:512]), reduction, r_norm,
-                            batched=batched_expand,
+                            batched=batched_expand, cfg=cfg,
                         )
                     )
             if (
@@ -1027,4 +1047,5 @@ def realize_profile(
         )
         return C_sup, p_sup, float(eps), lp_solves
     finally:
+        _guards.close()
         pricer.close()

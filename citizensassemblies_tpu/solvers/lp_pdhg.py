@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.guards import no_implicit_transfers
 
 
 @dataclasses.dataclass
@@ -244,10 +245,15 @@ def solve_lp(
         x0 = jnp.zeros(nv, f32)
         lam0 = jnp.zeros(m1, f32)
         mu0 = jnp.zeros(m2, f32)
-    x, lam, mu, it, res = _pdhg_core(
-        c_, G_, h_, A_, b_, x0, lam0, mu0, jnp.float32(tol),
-        max_iters=int(cfg.pdhg_max_iters), check_every=int(cfg.pdhg_check_every),
-    )
+    # inputs are explicitly materialized above (a bare np.float32 scalar for
+    # tol would itself be an implicit transfer); inside the guard a stray
+    # numpy operand re-uploaded per CG round raises
+    tol_ = jnp.asarray(tol, jnp.float32)
+    with no_implicit_transfers(cfg):
+        x, lam, mu, it, res = _pdhg_core(
+            c_, G_, h_, A_, b_, x0, lam0, mu0, tol_,
+            max_iters=int(cfg.pdhg_max_iters), check_every=int(cfg.pdhg_check_every),
+        )
     x = np.asarray(x, dtype=np.float64)
     lam = np.asarray(lam, dtype=np.float64)
     mu = np.asarray(mu, dtype=np.float64)
@@ -503,17 +509,25 @@ def solve_two_sided_master(
         mu0 = np.float32(0.0)
     colmask = np.zeros(Cp, dtype=np.float32)
     colmask[:C] = 1.0
-    x, lam, mu, it, res = _pdhg_two_sided_core(
+    # every operand is materialized to a device array BEFORE the guard scope
+    # (a dtype-converting asarray binds convert_element_type eagerly, which
+    # the transfer guard counts as an implicit upload); inside the guard the
+    # hot call may only touch what is already resident
+    operands = (
         jnp.asarray(MTp, f32),
         jnp.asarray(v, f32),
         jnp.asarray(colmask, f32),
         jnp.asarray(x0, f32),
         jnp.asarray(lam0, f32),
         jnp.asarray(mu0, f32),
-        jnp.float32(tol),
-        max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
-        check_every=int(cfg.pdhg_check_every),
+        jnp.asarray(tol, jnp.float32),
     )
+    with no_implicit_transfers(cfg):
+        x, lam, mu, it, res = _pdhg_two_sided_core(
+            *operands,
+            max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
+            check_every=int(cfg.pdhg_check_every),
+        )
     x = np.asarray(x, dtype=np.float64)
     lam = np.asarray(lam, dtype=np.float64)
     mu = np.asarray(mu, dtype=np.float64)

@@ -167,9 +167,16 @@ def solve_final_primal_l2(
     L = max(sigma_sq / 2.0, 1.0)
     with log.timer("l2_dual_ascent"):
         lam0 = jnp.zeros((2 * Pj.shape[1],), dtype=Pj.dtype)
-        p, _lam = _min_norm_dual_ascent(
-            Pj, tj, jnp.float32(eps), jnp.float32(1.0 / L), lam0, iters
-        )
+        # the jitted ascent runs under the no-implicit-transfer guard: every
+        # operand is materialized to a device array BEFORE the scope (the
+        # scalar conversions too — an eager convert_element_type on a python
+        # float inside the guard counts as an implicit upload, utils/guards)
+        from citizensassemblies_tpu.utils.guards import no_implicit_transfers
+
+        eps_dev = jnp.asarray(eps, jnp.float32)
+        step_dev = jnp.asarray(1.0 / L, jnp.float32)
+        with no_implicit_transfers(cfg):
+            p, _lam = _min_norm_dual_ascent(Pj, tj, eps_dev, step_dev, lam0, iters)
         # host materialization inside the timer: through a TPU tunnel,
         # block_until_ready alone does not drain the pipeline (see bench.py)
         p = np.asarray(p, dtype=np.float64)

@@ -56,7 +56,10 @@ class Config:
     #: violated compositions added per stage-LP solve in type-space CG (cheap
     #: to carry: the stage LP has one row per type regardless of columns).
     cg_columns_typespace: int = 512
-    #: maximum committees held in the padded portfolio buffer (static shape).
+    #: cap on the agent-space portfolio: once reached, batched stochastic
+    #: pricing stops ADDING columns and the exact oracle carries the tail
+    #: (one certified column per round, exactly the reference's loop shape) —
+    #: the buffer the padded dual LPs solve over stays bounded.
     max_portfolio: int = 8_192
 
     # --- type-space enumeration ----------------------------------------------
@@ -79,9 +82,11 @@ class Config:
     decompose_budget: int = 16_384
     #: probe-LP tolerance certifying that a type cannot exceed the stage value.
     probe_tol: float = 1e-7
-    #: accept the relaxation-leximin profile when the decomposition LP
-    #: realizes it within this downward deviation (certifies exactness: the
-    #: relaxation dominates every achievable profile in leximin order).
+    #: panel-decomposition polish tolerance on the ENUMERATED type-space path
+    #: (``models/leximin.py``): the decomposition accepts when it realizes
+    #: the composition mixture's marginals within this deviation. The CG
+    #: path floors it at its greedy noise scale (2e-5), and large instances
+    #: at 2.5e-4 — see the tol derivation at the call site.
     decomp_tol: float = 1e-6
     #: after the pricing rounds are exhausted, still accept the relaxation
     #: profile when the residual is below this; only a larger residual — a
@@ -226,6 +231,17 @@ class Config:
     force_agent_space: bool = False
     #: random seed used by solver-internal sampling (not MC estimation).
     solver_seed: int = 0
+
+    # --- runtime guard rails --------------------------------------------------
+    #: ``jax.transfer_guard`` mode wrapped around the jitted hot calls
+    #: (``utils/guards.no_implicit_transfers``: the PDHG cores, the sharded
+    #: solver, the batched move screen): "disallow" raises on any IMPLICIT
+    #: host↔device transfer inside those scopes (a numpy array reaching a
+    #: jitted call re-uploads through the TPU tunnel every invocation),
+    #: "log" warns instead, "off" removes the scope. Explicit conversions
+    #: (``jnp.asarray``, ``jax.device_put``) are always allowed — the fix
+    #: for a violation is to materialize the operand once, outside the loop.
+    transfer_guard: str = "disallow"
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)

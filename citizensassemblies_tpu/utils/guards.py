@@ -1,0 +1,153 @@
+"""Runtime guard rails for the JAX invariants the flagship speedups rest on.
+
+The static side of this contract lives in ``citizensassemblies_tpu.lint``
+(graftlint): host-sync calls must not be reachable from jitted code, jits must
+not be constructed per call, donated buffers must not be reused. Static
+analysis cannot see *dynamic* regressions though — a shape drifting out of its
+padding bucket recompiles the PDHG core every CG round, and a numpy array
+sneaking into a jitted call re-uploads it through the TPU tunnel per
+invocation. The two guards here catch exactly those at runtime:
+
+* :class:`CompilationGuard` — counts XLA compilations inside a scope via the
+  ``jax.monitoring`` backend-compile event, optionally asserting a bound.
+  Wired into ``face_decompose.realize_profile`` (the count lands in the run's
+  phase counters as ``xla_compiles_decomp``) and around the bench's flagship
+  reps, where steady-state reps assert ~zero recompiles.
+* :func:`no_implicit_transfers` — a ``jax.transfer_guard`` scope around the
+  jitted hot calls in ``lp_pdhg``, ``qp``, ``parallel/solver`` and
+  ``face_decompose``. Explicit conversions (``jnp.asarray``,
+  ``jax.device_put``) stay legal; an *implicit* transfer — a numpy array or a
+  bare-scalar eager op reaching the device path inside the scope — raises
+  (mode ``"disallow"``) or warns (``"log"``). ``Config.transfer_guard``
+  selects the mode; ``"off"`` removes the scope entirely.
+
+Both guards are deliberately import-light: ``jax`` is imported lazily so the
+module (and the lint package, which never needs a device) stays importable
+anywhere.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import List, Optional
+
+#: the jax.monitoring duration event emitted once per XLA backend compile
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+_lock = threading.Lock()
+_active_guards: List["CompilationGuard"] = []
+_listener_installed = False
+
+
+class GuardViolation(RuntimeError):
+    """A runtime guard's asserted bound was exceeded."""
+
+
+def _install_listener() -> None:
+    """Register the (process-global) compile-event listener once.
+
+    ``jax.monitoring`` has no unregister API, so the listener stays installed
+    and fans out to whatever guards are active at event time — a no-op when
+    none are.
+    """
+    global _listener_installed
+    with _lock:
+        if _listener_installed:
+            return
+        import jax.monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if not event.endswith(_COMPILE_EVENT_SUFFIX):
+                return
+            with _lock:
+                for guard in _active_guards:
+                    guard.count += 1
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _listener_installed = True
+
+
+class CompilationGuard:
+    """Count XLA compilations inside a ``with`` scope.
+
+    ``log`` (a :class:`~citizensassemblies_tpu.utils.logging.RunLog`) receives
+    the count as the phase counter ``xla_compiles_<name>`` on exit, so the
+    number rides the same in-band channel as the warm-start/overlap counters.
+    ``max_compiles`` asserts a bound: exceeding it raises
+    :class:`GuardViolation` on exit (after the count is logged) — the
+    bench/test form of "this phase must not recompile per round".
+
+    Guards nest; each counts independently. The count includes *every* XLA
+    compile in scope (eager ops compiling a new shape too), which is the
+    honest metric — a recompile is paid wherever it comes from.
+    """
+
+    def __init__(
+        self,
+        name: str = "phase",
+        log=None,
+        max_compiles: Optional[int] = None,
+    ):
+        self.name = name
+        self.log = log
+        self.max_compiles = max_compiles
+        self.count = 0
+
+    def __enter__(self) -> "CompilationGuard":
+        _install_listener()
+        self.count = 0
+        with _lock:
+            _active_guards.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        with _lock:
+            try:
+                _active_guards.remove(self)
+            except ValueError:  # pragma: no cover - double exit
+                pass
+        if self.log is not None and self.count:
+            self.log.count(f"xla_compiles_{self.name}", self.count)
+        if (
+            exc_type is None
+            and self.max_compiles is not None
+            and self.count > self.max_compiles
+        ):
+            raise GuardViolation(
+                f"{self.name}: {self.count} XLA compilations inside a scope "
+                f"bounded at {self.max_compiles} — a shape left its padding "
+                f"bucket or a jit is being rebuilt per call"
+            )
+
+
+def _transfer_mode(cfg) -> str:
+    """Resolve the transfer-guard mode from a Config (default: disallow)."""
+    if cfg is None:
+        return "disallow"
+    return str(getattr(cfg, "transfer_guard", "disallow"))
+
+
+@contextmanager
+def no_implicit_transfers(cfg=None, mode: Optional[str] = None):
+    """``jax.transfer_guard`` scope for a jitted hot call.
+
+    Inside the scope, *implicit* host↔device transfers — a numpy array passed
+    straight into a jitted call (re-uploaded through the TPU tunnel every
+    invocation), a bare python scalar promoted by an eager op — raise
+    (``"disallow"``) or warn (``"log"``). Explicit ``jnp.asarray`` /
+    ``jax.device_put`` conversions remain legal, so the fix for a violation
+    is always "materialize the operand once, outside the loop".
+
+    ``mode`` overrides; otherwise ``cfg.transfer_guard`` decides, and
+    ``"off"`` makes the whole context a no-op (the escape hatch for backends
+    whose dispatch path transfers internally).
+    """
+    resolved = mode if mode is not None else _transfer_mode(cfg)
+    if resolved in ("off", "", None):
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard(resolved):
+        yield

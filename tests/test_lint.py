@@ -1,0 +1,310 @@
+"""graftlint self-tests: one known-violating fixture per rule R1–R6, the
+suppression syntax (including the reason requirement, R0), and the clean
+pass over the real package — which is what makes a NEW violation fail
+tier-1, per the CI contract in README "Static analysis & guard rails".
+"""
+
+from pathlib import Path
+
+from citizensassemblies_tpu.lint import lint_paths, render_report
+from citizensassemblies_tpu.lint.cli import main as lint_main
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _lint(tmp_path, sources: dict, readme: str = None):
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src, encoding="utf-8")
+    readme_path = None
+    if readme is not None:
+        readme_path = tmp_path / "README.md"
+        readme_path.write_text(readme, encoding="utf-8")
+    return lint_paths([tmp_path], root=tmp_path, readme=readme_path)
+
+
+def _rules(report):
+    return {v.rule for v in report.violations}
+
+
+# --- R1: host sync reachable from jit ---------------------------------------
+
+
+def test_r1_host_sync_in_jit(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax\n"
+        "import numpy as np\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return helper(x)\n"
+        "\n"
+        "def helper(x):\n"
+        "    y = np.asarray(x)\n"
+        "    return x.item() + float(y)\n"
+    )})
+    msgs = [v for v in report.violations if v.rule == "R1"]
+    assert msgs, render_report(report)
+    # both the materializer and the sync call are caught, through one level
+    # of same-module reachability
+    assert any("np.asarray" in v.message for v in msgs)
+    assert any(".item()" in v.message for v in msgs)
+
+
+def test_r1_host_code_not_flagged(tmp_path):
+    # the same calls OUTSIDE jit-reachable code are legitimate host marshalling
+    report = _lint(tmp_path, {"mod.py": (
+        "import numpy as np\n"
+        "\n"
+        "def host_only(x):\n"
+        "    return float(np.asarray(x).sum())\n"
+    )})
+    assert "R1" not in _rules(report)
+
+
+# --- R2: jit constructed per call / in loops --------------------------------
+
+
+def test_r2_jit_in_loop(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax\n"
+        "\n"
+        "def run(xs):\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        f = jax.jit(lambda y: y + 1)\n"
+        "        out.append(f(x))\n"
+        "    return out\n"
+    )})
+    assert "R2" in _rules(report), render_report(report)
+
+
+def test_r2_memoized_and_factory_allowed(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax\n"
+        "\n"
+        "_CACHE = {}\n"
+        "\n"
+        "def cached(key, fn):\n"
+        "    got = _CACHE.get(key)\n"
+        "    if got is None:\n"
+        "        got = jax.jit(fn)\n"
+        "        _CACHE[key] = got\n"
+        "    return got\n"
+        "\n"
+        "def factory(fn):\n"
+        "    wrapped = jax.jit(fn)\n"
+        "    return wrapped\n"
+    )})
+    assert "R2" not in _rules(report), render_report(report)
+
+
+# --- R3: donated buffer reuse -----------------------------------------------
+
+
+def test_r3_donated_reuse(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from functools import partial\n"
+        "import jax\n"
+        "\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(carry, delta):\n"
+        "    return carry + delta\n"
+        "\n"
+        "def advance(carry, delta):\n"
+        "    new = step(carry, delta)\n"
+        "    return new + carry\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R3"]
+    assert viols, render_report(report)
+    assert "'carry'" in viols[0].message
+
+
+def test_r3_rebind_is_fine(tmp_path):
+    # x0 = step(x0, d): the donated name is REBOUND by the very statement,
+    # so later reads see the fresh output buffer
+    report = _lint(tmp_path, {"mod.py": (
+        "from functools import partial\n"
+        "import jax\n"
+        "\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(carry, delta):\n"
+        "    return carry + delta\n"
+        "\n"
+        "def loop(x0, d):\n"
+        "    x0 = step(x0, d)\n"
+        "    return x0\n"
+    )})
+    assert "R3" not in _rules(report), render_report(report)
+
+
+# --- R4: dtype discipline ---------------------------------------------------
+
+
+def test_r4_jnp_float64_outside_whitelist(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def residual(x):\n"
+        "    return jnp.asarray(x, dtype=jnp.float64)\n"
+    )})
+    assert "R4" in _rules(report), render_report(report)
+
+
+def test_r4_float32_inside_certification_path(tmp_path):
+    report = _lint(tmp_path, {"solvers/lp_util.py": (
+        "import numpy as np\n"
+        "\n"
+        "def certify(r):\n"
+        "    return r.astype(np.float32)\n"
+    )})
+    assert "R4" in _rules(report), render_report(report)
+
+
+# --- R5: tracer branching / unhashable statics ------------------------------
+
+
+def test_r5_branch_on_tracer(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )})
+    assert "R5" in _rules(report), render_report(report)
+
+
+def test_r5_none_dispatch_and_static_branch_allowed(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from functools import partial\n"
+        "import jax\n"
+        "\n"
+        "@partial(jax.jit, static_argnames=('mode',))\n"
+        "def f(x, scores, mode):\n"
+        "    if scores is None:\n"
+        "        scores = x\n"
+        "    if mode:\n"
+        "        return x + scores\n"
+        "    return x\n"
+    )})
+    assert "R5" not in _rules(report), render_report(report)
+
+
+def test_r5_unhashable_static(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from functools import partial\n"
+        "import jax\n"
+        "\n"
+        "@partial(jax.jit, static_argnames=('mode',))\n"
+        "def g(x, mode):\n"
+        "    return x\n"
+        "\n"
+        "def call(x):\n"
+        "    return g(x, mode=[1, 2])\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R5"]
+    assert viols and any("unhashable" in v.message for v in viols)
+
+
+# --- R6: config-knob hygiene ------------------------------------------------
+
+
+def test_r6_dead_and_undocumented_knobs(tmp_path):
+    report = _lint(
+        tmp_path,
+        {
+            "pkg/utils/config.py": (
+                "import dataclasses\n"
+                "\n"
+                "@dataclasses.dataclass(frozen=True)\n"
+                "class Config:\n"
+                "    live_knob: int = 1\n"
+                "    dead_knob: int = 2\n"
+            ),
+            "pkg/solver.py": (
+                "def use(cfg):\n"
+                "    return cfg.live_knob\n"
+            ),
+        },
+        readme="Documented here: `live_knob`.\n",
+    )
+    viols = [v for v in report.violations if v.rule == "R6"]
+    # dead_knob fails twice (unread + undocumented); live_knob passes
+    assert len(viols) == 2, render_report(report)
+    assert all("dead_knob" in v.message for v in viols)
+
+
+# --- suppression syntax -----------------------------------------------------
+
+
+def test_suppression_with_reason(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def residual(x):\n"
+        "    # graftlint: disable=R4 -- audited: only runs under enabled x64\n"
+        "    return jnp.asarray(x, dtype=jnp.float64)\n"
+    )})
+    assert report.ok, render_report(report)
+    assert report.suppressed == 1
+
+
+def test_suppression_without_reason_is_flagged(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def residual(x):\n"
+        "    # graftlint: disable=R4\n"
+        "    return jnp.asarray(x, dtype=jnp.float64)\n"
+    )})
+    rules = _rules(report)
+    assert "R0" in rules, render_report(report)
+    assert "R4" not in rules  # the suppression still applies; the R0 remains
+
+
+def test_file_wide_suppression(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "# graftlint: disable-file=R4 -- fixture module, downcasts on purpose\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def a(x):\n"
+        "    return jnp.asarray(x, dtype=jnp.float64)\n"
+        "\n"
+        "def b(x):\n"
+        "    return jnp.asarray(x, dtype=jnp.float64)\n"
+    )})
+    assert report.ok, render_report(report)
+    assert report.suppressed == 2
+
+
+# --- the real package must be clean (tier-1 integration) --------------------
+
+
+def test_real_package_is_lint_clean():
+    """The acceptance contract: ``python -m citizensassemblies_tpu.lint
+    citizensassemblies_tpu/`` exits 0 — every pre-existing violation fixed or
+    explicitly suppressed with a reason. Running it inside tier-1 makes any
+    NEW violation a test failure."""
+    report = lint_paths(
+        [REPO_ROOT / "citizensassemblies_tpu"],
+        root=REPO_ROOT,
+        readme=REPO_ROOT / "README.md",
+    )
+    assert report.ok, render_report(report)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "def run(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(lambda y: y)(x)\n",
+        encoding="utf-8",
+    )
+    assert lint_main([str(bad)]) == 1
+    assert lint_main([str(REPO_ROOT / "citizensassemblies_tpu")]) == 0
