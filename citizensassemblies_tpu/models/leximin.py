@@ -212,8 +212,14 @@ def _typespace_leximin(
             f"{len(comps)} feasible compositions."
         )
         with log.timer("typespace_lp"):
+            # cfg rides along for the batched probe prescreen
+            # (solvers/batch_lp.py) — including on SMALL enumerated
+            # instances (mass_24-class), where the fixed per-run dispatch
+            # floor is amortized across the whole probe fleet instead of
+            # being paid per host LP
             ts = leximin_over_compositions(
-                comps, reduction.msize, probe_tol=cfg.probe_tol, log=log
+                comps, reduction.msize, probe_tol=cfg.probe_tol, log=log,
+                cfg=cfg,
             )
     else:
         # too many types to enumerate: column generation over compositions,

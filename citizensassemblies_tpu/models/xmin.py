@@ -122,7 +122,12 @@ def find_distribution_xmin(
     # The LEXIMIN probabilities are the feasible ε-floor donor: they realize
     # the targets within the leximin stage's own ε over the portfolio PREFIX,
     # so the (possibly pathological — see solve_final_primal_l2) host ε-LP
-    # never runs on the expansion path
+    # never runs on the expansion path. With the batched LP engine enabled
+    # the min-ε anchor + ε-floor pick + dual ascent run FUSED as one jitted
+    # device call with an on-device convergence check (qp._get_l2_fused_core
+    # — the timer below then contains `l2_fused` instead of the serial
+    # `l2_eps_pdhg`/`l2_dual_ascent` pair, and `lp_batch_l2_fused` appears
+    # in the run's phase counters)
     with log.timer("xmin_l2"):
         probs, eps_dev = solve_final_primal_l2(
             P, leximin.fixed_probabilities, iters=cfg.xmin_qp_iters, log=log,
@@ -198,6 +203,11 @@ def find_distribution_xmin(
                 f"panels → support {support} "
                 f"(L∞ dev {float(np.abs(allocation - t).max()):.2e} ≤ band {band:g})."
             )
+    if log.counters.get("lp_batch_l2_fused"):
+        log.emit(
+            "XMIN L2 stage ran fused on the batched LP engine "
+            "(anchor + floor pick + spread in one device call)."
+        )
     log.emit(f"XMIN done: support {(probs > 1e-11).sum()} committees, ε = {eps_dev:.2e}.")
     final_dev = float(np.abs(allocation - leximin.fixed_probabilities).max())
     return Distribution(

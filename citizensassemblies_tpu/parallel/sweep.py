@@ -88,3 +88,76 @@ def sweep_legacy_allocations(
     axes = jax.tree_util.tree_map(lambda _: 0, batched)
     alloc, rate = jax.vmap(one, in_axes=(axes, 0))(batched, keys)
     return np.asarray(alloc, dtype=np.float64), np.asarray(rate, dtype=np.float64)
+
+
+def sweep_lp_batch(
+    problems,
+    cfg=None,
+    log=None,
+    mesh=None,
+    warm_key: Optional[str] = None,
+    tol: Optional[float] = None,
+    max_iters: Optional[int] = None,
+):
+    """Shard whole LP buckets of a sweep across the mesh.
+
+    The batch-parallel sibling of :func:`sweep_legacy_allocations` for the
+    *convex-solve* fleets a sweep produces (one final ε-LP / recovery LP per
+    instance): ``problems`` is a sequence of
+    :class:`~citizensassemblies_tpu.solvers.batch_lp.BatchLP` instances, and
+    the shape-bucketed engine solves each padded bucket as ONE vmapped
+    device call with the batch axis laid out over the mesh — the same
+    engine, executable cache, and warm-start slots the single-chip call
+    sites use, so multi-instance sweeps inherit the bucketing policy
+    instead of growing a second dispatch path. With one visible device the
+    mesh layout degenerates to the plain single-chip call.
+    """
+    from citizensassemblies_tpu.solvers.batch_lp import solve_lp_batch
+
+    if mesh is None and jax.device_count() > 1:
+        from citizensassemblies_tpu.parallel.mesh import default_mesh
+
+        mesh = default_mesh()
+    return solve_lp_batch(
+        problems, cfg=cfg, log=log, warm_key=warm_key, tol=tol,
+        max_iters=max_iters, mesh=mesh,
+    )
+
+
+def sweep_final_primal_eps(
+    portfolios: Sequence[np.ndarray],
+    targets: Sequence[np.ndarray],
+    cfg=None,
+    log=None,
+    mesh=None,
+    tol: Optional[float] = None,
+) -> List[Tuple[np.ndarray, float]]:
+    """Final ε-LPs of a whole sweep in bucketed, mesh-sharded device calls.
+
+    For every (portfolio ``P_i`` bool[C_i, n_i], target ``t_i`` float[n_i])
+    pair, solves ``min ε s.t. P_iᵀp ≥ t_i − ε, Σp = 1, p ≥ 0``
+    (``leximin.py:453-464``) and returns ``[(p_i, ε_i), …]`` with ``ε_i``
+    the float64 *arithmetic* downward deviation ``max(t_i − P_iᵀp, 0)`` of
+    the returned normalized mixture (the quantity this LP minimizes) — the
+    same solver-independent certificate style the single-instance paths
+    use, so a non-converged lane is visible in its ε, never silently wrong.
+    """
+    from citizensassemblies_tpu.solvers.batch_lp import final_primal_batch_lp
+
+    problems = [
+        final_primal_batch_lp(P, t, tol=tol)
+        for P, t in zip(portfolios, targets)
+    ]
+    sols = sweep_lp_batch(problems, cfg=cfg, log=log, mesh=mesh, tol=tol)
+    out: List[Tuple[np.ndarray, float]] = []
+    for P, t, sol in zip(portfolios, targets, sols):
+        C = P.shape[0]
+        p = np.maximum(np.asarray(sol.x[:C], dtype=np.float64), 0.0)
+        total = p.sum()
+        if not np.isfinite(total) or total <= 0.0:
+            p = np.full(C, 1.0 / max(C, 1))
+        else:
+            p = p / total
+        deficit = np.asarray(t, dtype=np.float64) - P.T.astype(np.float64) @ p
+        out.append((p, float(np.maximum(deficit, 0.0).max())))
+    return out

@@ -1,0 +1,434 @@
+"""Batched, shape-bucketed LP/QP engine: fuse fleets of small convex solves.
+
+After the face loop was pipelined (PR 1) the remaining wall-clock is
+dominated by *many small independent* LP/QP solves dispatched one at a time:
+polish attempts in the decomposition end-game, per-candidate probe LPs of
+the leximin certification, per-instance final LPs of a parameter sweep.
+Each costs a full device round-trip (through a TPU tunnel ~0.16 s/dispatch)
+regardless of its size, so a fleet of N small solves pays N dispatch floors
+for work the MXU could do in one.
+
+This engine takes N independent instances of ``min cᵀx s.t. Gx ≤ h, Ax = b,
+x ≥ 0``, pads them into power-of-two shape buckets ``(rows_G, rows_A, cols,
+batch)`` and solves each bucket with a single ``vmap``-ped, jitted
+restarted-PDHG call — the *same* iteration body the serial solver runs
+(``lp_pdhg._pdhg_body``), so the per-instance math is one definition with
+two dispatch shapes. The bucketing/serving mechanics mirror a serving
+stack's continuous batching:
+
+* **shape buckets** — dims round up to a power of two below
+  ``Config.lp_batch_bucket_max`` and to a multiple of it above, so each
+  distinct bucket compiles once and the executable cache stays bounded
+  (``CompilationGuard`` counts per-bucket compiles into the run's
+  ``lp_batch_*`` phase counters);
+* **padding is inert by construction** — padded rows/columns are all-zero
+  with zero objective and zero offsets (0 ≤ 0 constraints, variables that
+  keep zero gradient), and padding *lanes* are all-zero instances whose KKT
+  residual is 0 at the start, so they converge at the first check;
+* **per-instance convergence masks** — the vmapped ``lax.while_loop`` runs
+  until every lane's own ``res ≤ tol``; lanes that finish early have their
+  carries frozen by the batching rule's select masks, so an easy instance's
+  solution is unaffected by a hard bucket-mate (each lane reports its own
+  iteration count);
+* **warm-start slots keyed per caller** — ``warm_key`` stores each
+  instance's (x, λ, μ) triple at its REAL (unpadded) size and re-pads it
+  into whatever bucket the next call lands in, including tail variables
+  (e.g. an ε slot pinned to the last position) that must survive a column
+  growth;
+* **donated carry** — the stacked warm buffers are donated to the jitted
+  core exactly as in the serial solver;
+* **mesh sharding** — with a multi-device mesh the batch axis is laid out
+  over the devices via an explicit ``NamedSharding`` and the same jitted
+  core runs SPMD-partitioned, so sweep-level fleets scale out without a
+  second code path (``parallel/sweep.py``).
+
+The engine is strictly a wall-clock mechanism: callers keep their own
+acceptance semantics (arithmetic residuals, float64 host confirms), and
+with ``Config.lp_batch`` off every call site runs its serial path
+bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
+
+
+@dataclasses.dataclass
+class BatchLP:
+    """One instance of ``min cᵀx s.t. Gx ≤ h, Ax = b, x ≥ 0``.
+
+    ``tol`` overrides the engine-level tolerance per instance. ``tail_vars``
+    marks how many TRAILING variables are structural (e.g. the ε slot of an
+    ε-LP): a warm-slot re-pad keeps them pinned to the end of the padded
+    variable vector instead of letting a column growth shift them into the
+    middle. ``warm`` supplies an explicit (x, λ_G, μ_A) warm start at the
+    instance's real sizes; when absent and ``warm_key`` is given, the
+    engine's slot for (key, position) is used.
+    """
+
+    c: np.ndarray
+    G: np.ndarray
+    h: np.ndarray
+    A: np.ndarray
+    b: np.ndarray
+    tol: Optional[float] = None
+    tail_vars: int = 0
+    warm: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+
+
+#: smallest padded dimension — below this the pow-2 ladder just adds
+#: dispatch-sized noise; 8 matches the f32 sublane tile
+_BUCKET_FLOOR = 8
+
+#: padding lanes make a batch a power of two; solved-instance tolerance for
+#: those lanes is huge so an all-zero instance never gates the while_loop
+_PAD_TOL = 1.0
+
+
+def _bucket_dim(size: int, cap: int) -> int:
+    """Power-of-two bucket below ``cap``, multiple-of-``cap`` above it."""
+    size = max(int(size), 1)
+    if size >= cap:
+        return -(-size // cap) * cap
+    b = _BUCKET_FLOOR
+    while b < size:
+        b *= 2
+    return min(b, cap)
+
+
+def lp_batch_enabled(cfg: Optional[Config]) -> bool:
+    """Resolve the ``Config.lp_batch`` tri-state: forced on/off, or auto
+    (accelerator backends on, CPU off — the same routing logic as the
+    device masters: per-call dispatch overhead outweighs batching on CPU).
+    """
+    cfg = cfg or default_config()
+    knob = getattr(cfg, "lp_batch", None)
+    if knob is not None:
+        return bool(knob)
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
+# --- the vmapped core --------------------------------------------------------
+
+#: memoized jitted cores per (max_iters, check_every): one vmapped program
+#: whose jit cache then holds one executable per padded bucket shape
+_BATCH_CORES: Dict[Tuple[int, int], object] = {}
+
+#: per-bucket dispatch / compile bookkeeping, for the bench's
+#: solves-per-dispatch and per-bucket compile evidence
+_BUCKET_STATS: Dict[str, Dict[str, int]] = {}
+
+#: warm-start slots: (warm_key, position) → (x, lam, mu, tail_vars) at the
+#: instance's REAL sizes (host float64 — slots survive bucket changes)
+_WARM_SLOTS: Dict[Tuple[str, int], Tuple[np.ndarray, np.ndarray, np.ndarray, int]] = {}
+
+
+def _get_batch_core(max_iters: int, check_every: int):
+    """Build (once per iteration schedule) the jitted vmapped PDHG core.
+
+    The per-lane body is the serial solver's ``_pdhg_body`` verbatim —
+    ``vmap`` adds the batch axis, the jit wrapper donates the stacked warm
+    carry, and the while_loop batching rule supplies the per-instance
+    convergence masks (a finished lane's carry is select-frozen while the
+    bucket runs on).
+    """
+    key = (int(max_iters), int(check_every))
+    core = _BATCH_CORES.get(key)
+    if core is None:
+        from functools import partial
+
+        import jax
+
+        from citizensassemblies_tpu.solvers.lp_pdhg import _pdhg_body
+
+        one = partial(_pdhg_body, max_iters=key[0], check_every=key[1])
+        core = jax.jit(jax.vmap(one), donate_argnums=(5, 6, 7))
+        _BATCH_CORES[key] = core
+    return core
+
+
+def _bucket_key(insts: Sequence[BatchLP], cap: int) -> Tuple[int, int, int]:
+    m1 = max(i.G.shape[0] for i in insts)
+    m2 = max(i.A.shape[0] for i in insts)
+    nv = max(i.c.shape[0] for i in insts)
+    return (_bucket_dim(m1, cap), _bucket_dim(m2, cap), _bucket_dim(nv, cap))
+
+
+def _repad_warm(
+    warm: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    tail_vars: int,
+    nv: int,
+    m1: int,
+    m2: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Re-pad a real-sized warm triple into (nv, m1, m2) slots, keeping the
+    last ``tail_vars`` variables pinned to the END of the variable vector
+    (an ε slot must survive a column-bucket growth at its structural
+    position, not drift into the middle of the p block)."""
+    x_w, lam_w, mu_w = (np.asarray(a, dtype=np.float64).ravel() for a in warm)
+    x = np.zeros(nv)
+    tv = min(int(tail_vars), len(x_w), nv)
+    head_old = len(x_w) - tv
+    head = min(head_old, nv - tv)
+    x[:head] = x_w[:head]
+    if tv:
+        x[nv - tv :] = x_w[head_old:]
+    lam = np.zeros(m1)
+    lam[: min(m1, len(lam_w))] = lam_w[:m1]
+    mu = np.zeros(m2)
+    mu[: min(m2, len(mu_w))] = mu_w[:m2]
+    return x, lam, mu
+
+
+def clear_warm_slots(warm_key: Optional[str] = None) -> None:
+    """Drop the engine's warm-start slots (all of them, or one caller's)."""
+    if warm_key is None:
+        _WARM_SLOTS.clear()
+        return
+    for k in [k for k in _WARM_SLOTS if k[0] == warm_key]:
+        del _WARM_SLOTS[k]
+
+
+def bucket_stats() -> Dict[str, Dict[str, int]]:
+    """Per-bucket dispatch/solve/compile counts since process start — the
+    bench snapshots this around a row to attribute the engine's compiles."""
+    return {k: dict(v) for k, v in _BUCKET_STATS.items()}
+
+
+def solve_lp_batch(
+    problems: Sequence[BatchLP],
+    cfg: Optional[Config] = None,
+    log=None,
+    warm_key: Optional[str] = None,
+    tol: Optional[float] = None,
+    max_iters: Optional[int] = None,
+    mesh=None,
+    common_bucket: bool = False,
+):
+    """Solve N independent LPs as bucketed, vmapped device calls.
+
+    Instances are grouped into shape buckets (one jitted dispatch per
+    bucket, batch padded to a power of two with inert all-zero lanes) and
+    each bucket is solved by the vmapped restarted-PDHG core. Returns a
+    list of :class:`~citizensassemblies_tpu.solvers.lp_pdhg.LPSolution`
+    in input order, each sliced back to its instance's real sizes.
+
+    ``warm_key`` engages the engine's warm-start slots: instance i of a
+    repeat caller resumes from its previous (x, λ, μ) triple, re-padded
+    into whatever bucket the new call lands in (``BatchLP.tail_vars``
+    keeps structural trailing variables pinned through column growth).
+    ``mesh`` (a multi-device ``jax.sharding.Mesh``) lays the batch axis
+    out over the devices so whole buckets run SPMD-partitioned.
+    ``common_bucket`` pads EVERY instance into one shared bucket (the max
+    of each dim) — for fleets of nested/near-equal shapes (the polish-face
+    screen's support prefixes) where one fused dispatch beats per-shape
+    grouping; zero padding columns are free MXU work, a second dispatch is
+    not.
+
+    Counters on ``log`` (a ``RunLog``): ``lp_batch_dispatches`` (device
+    calls), ``lp_batch_solves`` (real instances), ``lp_batch_pad_lanes``
+    (inert padding lanes), ``lp_batch_warm_hits`` and per-bucket
+    ``lp_batch_compiles_<rows>x<eq>x<cols>x<batch>`` whenever a dispatch
+    compiled — so bench rows show solves-per-dispatch and per-bucket
+    compile counts.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from citizensassemblies_tpu.solvers.lp_pdhg import LPSolution
+
+    cfg = cfg or default_config()
+    if not problems:
+        return []
+    cap = max(int(getattr(cfg, "lp_batch_bucket_max", 4096)), _BUCKET_FLOOR)
+    base_tol = float(tol if tol is not None else cfg.pdhg_tol)
+    iters = int(max_iters if max_iters is not None else cfg.pdhg_max_iters)
+    check_every = int(cfg.pdhg_check_every)
+
+    # group instance positions by bucket (insertion-ordered, deterministic)
+    groups: Dict[Tuple[int, int, int], List[int]] = {}
+    if common_bucket:
+        groups[_bucket_key(problems, cap)] = list(range(len(problems)))
+    else:
+        for i, inst in enumerate(problems):
+            key = _bucket_key([inst], cap)
+            groups.setdefault(key, []).append(i)
+
+    out: List[Optional[LPSolution]] = [None] * len(problems)
+    core = _get_batch_core(iters, check_every)
+    for (m1, m2, nv), idxs in groups.items():
+        B_real = len(idxs)
+        B = 1 << max(B_real - 1, 0).bit_length()  # pow-2 batch, floor 1
+        if mesh is not None:
+            ndev = int(mesh.devices.size)
+            B = -(-B // ndev) * ndev
+        f32 = np.float32
+        c = np.zeros((B, nv), f32)
+        G = np.zeros((B, m1, nv), f32)
+        h = np.zeros((B, m1), f32)
+        A = np.zeros((B, m2, nv), f32)
+        b = np.zeros((B, m2), f32)
+        x0 = np.zeros((B, nv), f32)
+        lam0 = np.zeros((B, m1), f32)
+        mu0 = np.zeros((B, m2), f32)
+        tols = np.full(B, _PAD_TOL, f32)
+        warm_hits = 0
+        for lane, i in enumerate(idxs):
+            inst = problems[i]
+            nvi, m1i, m2i = inst.c.shape[0], inst.G.shape[0], inst.A.shape[0]
+            c[lane, :nvi] = inst.c
+            G[lane, :m1i, :nvi] = inst.G
+            h[lane, :m1i] = inst.h
+            A[lane, :m2i, :nvi] = inst.A
+            b[lane, :m2i] = inst.b
+            tols[lane] = float(inst.tol if inst.tol is not None else base_tol)
+            warm = inst.warm
+            if warm is None and warm_key is not None:
+                slot = _WARM_SLOTS.get((warm_key, i))
+                if slot is not None:
+                    warm = slot[:3]
+                    warm_hits += 1
+            if warm is not None:
+                # re-pad at the instance's REAL sizes (tail variables keep
+                # their structural position inside the real column block —
+                # the bucket padding beyond ``nvi`` is all-zero columns the
+                # iterate never touches)
+                x_w, l_w, m_w = _repad_warm(warm, inst.tail_vars, nvi, m1i, m2i)
+                x0[lane, :nvi] = x_w
+                lam0[lane, :m1i] = l_w
+                mu0[lane, :m2i] = m_w
+
+        bkey = f"{m1}x{m2}x{nv}x{B}"
+        stats = _BUCKET_STATS.setdefault(
+            bkey, {"dispatches": 0, "solves": 0, "compiles": 0}
+        )
+        # operands are materialized to device arrays BEFORE the guard scope
+        # (the engine's whole point is one explicit upload per bucket); with
+        # a mesh the batch axis is laid out over the devices so the jitted
+        # core runs SPMD-partitioned without a second code path
+        if mesh is not None and int(mesh.devices.size) > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            axes = mesh.axis_names
+
+            def put(a):
+                spec = P(axes, *([None] * (a.ndim - 1)))
+                return jax.device_put(a, NamedSharding(mesh, spec))
+
+            operands = tuple(put(a) for a in (c, G, h, A, b, x0, lam0, mu0, tols))
+        else:
+            operands = tuple(
+                jnp.asarray(a) for a in (c, G, h, A, b, x0, lam0, mu0, tols)
+            )
+        with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
+            with no_implicit_transfers(cfg):
+                x, lam, mu, it, res = core(*operands)
+            x = np.asarray(x, dtype=np.float64)
+            lam = np.asarray(lam, dtype=np.float64)
+            mu = np.asarray(mu, dtype=np.float64)
+            it = np.asarray(it)
+            res = np.asarray(res)
+        stats["dispatches"] += 1
+        stats["solves"] += B_real
+        stats["compiles"] += guard.count
+        if log is not None:
+            log.count("lp_batch_dispatches")
+            log.count("lp_batch_solves", B_real)
+            if B > B_real:
+                log.count("lp_batch_pad_lanes", B - B_real)
+            if warm_hits:
+                log.count("lp_batch_warm_hits", warm_hits)
+            if guard.count:
+                log.count(f"lp_batch_compiles_{bkey}", guard.count)
+
+        for lane, i in enumerate(idxs):
+            inst = problems[i]
+            nvi, m1i, m2i = inst.c.shape[0], inst.G.shape[0], inst.A.shape[0]
+            xi = x[lane, :nvi]
+            li = lam[lane, :m1i]
+            mi = mu[lane, :m2i]
+            res_i = float(res[lane])
+            tol_i = float(tols[lane])
+            out[i] = LPSolution(
+                ok=bool(res_i <= tol_i * 4.0),  # same accept band as solve_lp
+                x=xi,
+                lam=li,
+                mu=mi,
+                objective=float(np.asarray(inst.c, dtype=np.float64) @ xi),
+                iters=int(it[lane]),
+                kkt=res_i,
+            )
+            if warm_key is not None:
+                _WARM_SLOTS[(warm_key, i)] = (xi, li, mi, int(inst.tail_vars))
+    return out
+
+
+def two_sided_master_batch_lp(
+    MT: np.ndarray, v: np.ndarray, tol: Optional[float] = None
+) -> BatchLP:
+    """Pack one two-sided ε master ``min ε s.t. v − ε ≤ MT p ≤ v + ε,
+    Σp = 1, p ≥ 0, ε ≥ 0`` into the engine's generic form (variables
+    ``[p (C), ε]``, ``tail_vars=1`` so warm slots survive column growth).
+    Row order matches ``solve_two_sided_master``: ``lam = [λ_lo (T),
+    λ_up (T)]``, so pricing duals are ``lam[:T] − lam[T:]``."""
+    T, C = MT.shape
+    G = np.zeros((2 * T, C + 1))
+    G[:T, :C] = -MT
+    G[T:, :C] = MT
+    G[:, C] = -1.0
+    h = np.concatenate([-np.asarray(v, dtype=np.float64), np.asarray(v, dtype=np.float64)])
+    A = np.zeros((1, C + 1))
+    A[0, :C] = 1.0
+    b = np.ones(1)
+    c = np.zeros(C + 1)
+    c[C] = 1.0
+    return BatchLP(c=c, G=G, h=h, A=A, b=b, tol=tol, tail_vars=1)
+
+
+def final_primal_batch_lp(
+    P: np.ndarray, target: np.ndarray, tol: Optional[float] = None
+) -> BatchLP:
+    """Pack one final ε-LP ``min ε s.t. Pᵀp ≥ target − ε, Σp = 1, p ≥ 0,
+    ε ≥ 0`` (``leximin.py:453-464``) into the engine's generic form —
+    the per-instance solve of a sweep's fleet (``parallel/sweep.py``)."""
+    P = np.asarray(P, dtype=np.float64)
+    C, n = P.shape
+    c = np.zeros(C + 1)
+    c[C] = 1.0
+    G = np.hstack([-P.T, -np.ones((n, 1))])
+    h = -np.asarray(target, dtype=np.float64)
+    A = np.zeros((1, C + 1))
+    A[0, :C] = 1.0
+    b = np.ones(1)
+    return BatchLP(c=c, G=G, h=h, A=A, b=b, tol=tol, tail_vars=1)
+
+
+def face_probe_batch_lp(
+    objective: np.ndarray,
+    A_face: np.ndarray,
+    b_face: np.ndarray,
+    tol: Optional[float] = None,
+) -> BatchLP:
+    """Pack one optimal-face probe ``max objective·x s.t. A_face x ≤ b_face,
+    Σx = 1, x ≥ 0`` (the certification probe of ``compositions.py``) into
+    the engine's MIN form (negated objective)."""
+    C = objective.shape[0]
+    A = np.ones((1, C))
+    b = np.ones(1)
+    return BatchLP(
+        c=-np.asarray(objective, dtype=np.float64),
+        G=np.asarray(A_face, dtype=np.float64),
+        h=np.asarray(b_face, dtype=np.float64),
+        A=A,
+        b=b,
+        tol=tol,
+    )

@@ -106,18 +106,7 @@ def _kkt_residual(c, G, h, A, b, x, lam, mu, scale):
     return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
 
 
-# the warm-start buffers (x0, lam0, mu0) are donated: they are loop-carried
-# iterates — each call's outputs become the next call's warm start, and the
-# wrappers below always materialize FRESH device arrays for them, so donation
-# lets XLA reuse the input buffers for the matching-shaped outputs instead of
-# allocating (and re-laying-out) a new carry every CG round. (CPU backends
-# ignore donation with a one-time note; the contract is unchanged.)
-@partial(
-    jax.jit,
-    static_argnames=("max_iters", "check_every"),
-    donate_argnums=(5, 6, 7),
-)
-def _pdhg_core(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: int):
+def _pdhg_body(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: int):
     m1, nv = G.shape
     m2 = A.shape[0]
     K = jnp.concatenate([G, A], axis=0)
@@ -212,6 +201,22 @@ def _pdhg_core(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: i
     lam_out = lam * d_r[:m1]
     mu_out = mu * d_r[m1:]
     return x_out, lam_out, mu_out, it, res
+
+
+# the warm-start buffers (x0, lam0, mu0) are donated: they are loop-carried
+# iterates — each call's outputs become the next call's warm start, and the
+# wrappers below always materialize FRESH device arrays for them, so donation
+# lets XLA reuse the input buffers for the matching-shaped outputs instead of
+# allocating (and re-laying-out) a new carry every CG round. (CPU backends
+# ignore donation with a one-time note; the contract is unchanged.) The
+# undecorated ``_pdhg_body`` stays importable so the batched engine
+# (``solvers/batch_lp.py``) can ``vmap`` the IDENTICAL iteration over a
+# padded instance bucket — one math definition, two dispatch shapes.
+_pdhg_core = partial(
+    jax.jit,
+    static_argnames=("max_iters", "check_every"),
+    donate_argnums=(5, 6, 7),
+)(_pdhg_body)
 
 
 def solve_lp(

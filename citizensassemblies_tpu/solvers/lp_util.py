@@ -71,6 +71,7 @@ def probe_confirm_tranche(
     face_max_relaxed: Optional[
         Callable[[np.ndarray], Tuple[Optional[float], Optional[np.ndarray]]]
     ] = None,
+    presumed_loose: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Certify which leximin tranche candidates are capped at ``z`` over a
     stage's optimal face.
@@ -112,6 +113,16 @@ def probe_confirm_tranche(
     An *infeasible* face from a group probe is never taken as evidence of
     tightness (this module's own header documents HiGHS falsely declaring
     feasible LPs infeasible): it falls through to the per-candidate probes.
+    ``presumed_loose`` (bool mask, same length as ``objectives``) marks
+    candidates a device prescreen has already WITNESSED loose at a
+    float64-validated face point (``compositions._batched_probe_prescreen``):
+    they are excluded from every probe and left unconfirmed — identical
+    outcome to probing them (a genuinely loose candidate can never be
+    confirmed; it is deferred to a later stage), minus the host LPs. The
+    mask can only REDUCE the LP count, never add a confirmation, so
+    soundness is untouched; with no mask (or an all-False one) the behavior
+    is bit-identical to the unscreened scheme.
+
     A per-candidate infeasible face certifies only after the face itself is
     confirmed non-empty (one zero-objective feasibility solve, cached per
     tranche) AND, when the caller supplies ``face_max_relaxed`` (the same
@@ -244,23 +255,32 @@ def probe_confirm_tranche(
     # member at min_allow is within each member's own budget, so one passing
     # LP settles the entire tranche even across mixed allowances (it may
     # spuriously fail when the freed slack genuinely concentrates — the
-    # equal-allowance chunks below then recover the precise verdicts)
+    # equal-allowance chunks below then recover the precise verdicts).
+    # Prescreen-witnessed loose candidates are excluded up front: they would
+    # make the group sum fail for certain, and probing them individually
+    # could only repeat what the witness already proved.
     order = np.argsort(-allowances)
-    if n > 1 and (n - 1) * term_deficit <= max_infl:
+    if presumed_loose is not None:
+        skip = np.asarray(presumed_loose, dtype=bool)
+        order = order[~skip[order]]
+    n_act = len(order)
+    if n_act == 0:
+        return confirmed
+    if n_act > 1 and (n_act - 1) * term_deficit <= max_infl:
         got, _x = face_max(np.sum(objectives[order], axis=0))
         if (
             got is not None
             and got != -np.inf
-            and got <= n * z + probe_tol + float(allowances.min())
+            and got <= n_act * z + probe_tol + float(allowances[order].min())
         ):
-            confirmed[:] = True
+            confirmed[order] = True
             return confirmed
     i = 0
-    while i < n:
+    while i < n_act:
         j = i + 1
         a_i = float(allowances[order[i]])
         while (
-            j < n
+            j < n_act
             and j - i < 256
             and abs(float(allowances[order[j]]) - a_i) <= 1e-12
             and (j - i) * term_deficit <= max_infl

@@ -15,6 +15,13 @@ for multipliers λ ≥ 0 on the coverage constraints, the inner minimization ove
 the simplex has the closed form ``p(λ) = proj_Δ(P λ / 2)``, and the dual
 gradient is the constraint residual — two matvecs per iteration, all jittable
 (``lax.fori_loop``), MXU-friendly, no host round-trips.
+
+Under ``Config.lp_batch`` the min-ε PDHG and the dual ascent fuse into ONE
+jitted device call (``_get_l2_fused_core``): the ε-floor pick happens on
+device and the ascent runs under a ``lax.while_loop`` with an on-device
+convergence check, replacing the serial path's chunked fori_loop + host
+sync per chunk. The float64 floor/blend arithmetic and all acceptance
+decisions stay on the host either way.
 """
 
 from __future__ import annotations
@@ -64,6 +71,106 @@ def _min_norm_dual_ascent(P, t, eps, lr, lam0, iters: int):
 
     lam = jax.lax.fori_loop(0, iters, body, lam0)
     return p_of(lam), lam
+
+
+#: memoized fused L2 cores per iteration schedule (one jitted program; its
+#: jit cache holds one executable per portfolio bucket shape)
+_L2_FUSED_CORES: dict = {}
+
+
+def _get_l2_fused_core(
+    eps_iters: int, check_every: int, chunk: int, max_chunks: int
+):
+    """Build (once per schedule) the FUSED min-ε + dual-ascent device call.
+
+    One jitted program runs the whole L2 stage that the serial path splits
+    into two device dispatches with a host sync between them
+    (``l2_eps_pdhg`` then ``l2_dual_ascent``): (1) the min-ε anchor PDHG on
+    the recovery LP, (2) the donor-vs-anchor ε-floor pick, (3) the dual
+    ascent with an ON-DEVICE convergence check — a ``lax.while_loop`` over
+    ``chunk``-iteration blocks that stops the moment the spread iterate's
+    per-block movement drops below tolerance, instead of grinding a fixed
+    20k-iteration ``fori_loop``. The host sees only the final iterates; the
+    float64 floor/blend arithmetic stays with the caller (soundness
+    unchanged).
+    """
+    key = (int(eps_iters), int(check_every), int(chunk), int(max_chunks))
+    core = _L2_FUSED_CORES.get(key)
+    if core is not None:
+        return core
+
+    import jax
+    import jax.numpy as jnp
+
+    from citizensassemblies_tpu.solvers.lp_pdhg import _pdhg_body, _power_norm
+
+    eps_iters, check_every, chunk, max_chunks = key
+
+    @jax.jit
+    def fused(P, t, p_don, eps_margin, eps_tol, ascent_tol):
+        f32 = P.dtype
+        C, n = P.shape
+        PT = P.T
+        # --- stage 1: min-ε anchor on the recovery LP (same generic PDHG
+        # body as the serial solver, constraint matrix built on device) ----
+        c = jnp.zeros(C + 1, f32).at[C].set(1.0)
+        G = jnp.concatenate([-PT, -jnp.ones((n, 1), f32)], axis=1)
+        h = -t
+        A = jnp.concatenate([jnp.ones(C, f32), jnp.zeros(1, f32)])[None, :]
+        b = jnp.ones(1, f32)
+        x, _lam, _mu, it_eps, _res = _pdhg_body(
+            c, G, h, A, b,
+            jnp.zeros(C + 1, f32), jnp.zeros(n, f32), jnp.zeros(1, f32),
+            eps_tol, max_iters=eps_iters, check_every=check_every,
+        )
+        q = jnp.clip(x[:C], 0.0, 1.0)
+        s = q.sum()
+        q_n = jnp.where(s > 0, q / jnp.maximum(s, 1e-30), p_don)
+        # --- stage 2: ε-floor pick, donor vs anchor, on device ------------
+        dev_q = jnp.abs(PT @ q_n - t).max()
+        dev_don = jnp.abs(PT @ p_don - t).max()
+        use_q = (s > 0) & (dev_q < dev_don)
+        p_floor = jnp.where(use_q, q_n, p_don)
+        eps = jnp.minimum(jnp.where(s > 0, dev_q, jnp.inf), dev_don) + eps_margin
+        # --- stage 3: dual ascent with on-device convergence check --------
+        sigma_sq = _power_norm(P) ** 2
+        lr = 1.0 / jnp.maximum(sigma_sq / 2.0, 1.0)
+
+        def p_of(lam):
+            return project_simplex((P @ (lam[:n] - lam[n:])) / 2.0)
+
+        def ascent_iter(lam, _):
+            p = p_of(lam)
+            alloc = PT @ p
+            resid_lo = (t - eps) - alloc
+            resid_up = alloc - (t + eps)
+            return (
+                jnp.maximum(
+                    lam + lr * jnp.concatenate([resid_lo, resid_up]), 0.0
+                ),
+                None,
+            )
+
+        def block(carry):
+            lam, p_prev, k, _delta = carry
+            lam, _ = jax.lax.scan(ascent_iter, lam, None, length=chunk)
+            p_new = p_of(lam)
+            delta = jnp.abs(p_new - p_prev).max()
+            return lam, p_new, k + 1, delta
+
+        def cond(carry):
+            _lam, _p, k, delta = carry
+            return (delta > ascent_tol) & (k < max_chunks)
+
+        lam0 = jnp.zeros(2 * n, f32)
+        p0 = p_of(lam0)
+        lam, p, k, _delta = jax.lax.while_loop(
+            cond, block, (lam0, p0, jnp.int32(0), jnp.float32(jnp.inf))
+        )
+        return p, p_floor, it_eps, k * chunk
+
+    _L2_FUSED_CORES[key] = fused
+    return fused
 
 
 def _min_eps_pdhg(P: np.ndarray, PT: np.ndarray, target: np.ndarray, cfg=None):
@@ -128,6 +235,7 @@ def solve_final_primal_l2(
         anchor_if_above = 0.5 * band
     PT = P.T.astype(np.float64)
     tgt = np.asarray(target, dtype=np.float64)
+    fused_p: Optional[np.ndarray] = None
     if floor_donor is not None:
         p_don = np.zeros(P.shape[0], dtype=np.float64)
         p_don[: len(floor_donor)] = np.asarray(floor_donor, dtype=np.float64)
@@ -141,10 +249,56 @@ def solve_final_primal_l2(
             # the anchor matters only when the donor's own deviation
             # approaches a caller's band (XMIN: 8e-4); a tight donor skips
             # the device solve outright
-            with log.timer("l2_eps_pdhg"):
-                p_pd, dev_pd = _min_eps_pdhg(P, PT, tgt, cfg=cfg)
-            if dev_pd < dev_don:
-                p_lp, eps_star = p_pd, dev_pd
+            from citizensassemblies_tpu.solvers.batch_lp import lp_batch_enabled
+
+            if lp_batch_enabled(cfg):
+                # FUSED path (solvers/batch_lp design): the min-ε anchor,
+                # the donor-vs-anchor floor pick and the dual ascent run as
+                # ONE jitted device call with an on-device convergence
+                # check, eliminating the anchor→host→ascent round-trip.
+                # The float64 floor/blend arithmetic below is unchanged —
+                # the fused call only moves WHERE the f32 iterates are
+                # produced, not how they are judged.
+                from citizensassemblies_tpu.utils.guards import (
+                    no_implicit_transfers,
+                )
+
+                chunk = 512
+                max_chunks = max(1, -(-int(iters) // chunk))
+                core = _get_l2_fused_core(
+                    12_288, int(getattr(cfg, "pdhg_check_every", 128) or 128),
+                    chunk, max_chunks,
+                )
+                with log.timer("l2_fused"):
+                    Pj = jnp.asarray(P, jnp.float32)
+                    tj = jnp.asarray(target, jnp.float32)
+                    dj = jnp.asarray(p_don, jnp.float32)
+                    margin_dev = jnp.asarray(eps_margin, jnp.float32)
+                    eps_tol_dev = jnp.asarray(1e-5, jnp.float32)
+                    asc_tol_dev = jnp.asarray(1e-7, jnp.float32)
+                    with no_implicit_transfers(cfg):
+                        p_dev, pf_dev, _it_eps, _it_asc = core(
+                            Pj, tj, dj, margin_dev, eps_tol_dev, asc_tol_dev
+                        )
+                    # host materialization inside the timer (see bench.py:
+                    # block_until_ready alone does not drain a TPU tunnel)
+                    fused_p = np.asarray(p_dev, dtype=np.float64)
+                    p_floor = np.clip(np.asarray(pf_dev, dtype=np.float64), 0.0, 1.0)
+                log.count("lp_batch_l2_fused")
+                sf = p_floor.sum()
+                if np.isfinite(sf) and sf > 0:
+                    p_floor = p_floor / sf
+                    # the ε floor the blend trusts is recomputed in float64
+                    # from the returned floor vector — the device's f32 pick
+                    # only chose WHICH vector, never the certified number
+                    dev_floor = float(np.abs(PT @ p_floor - tgt).max())
+                    if dev_floor < dev_don:
+                        p_lp, eps_star = p_floor, dev_floor
+            else:
+                with log.timer("l2_eps_pdhg"):
+                    p_pd, dev_pd = _min_eps_pdhg(P, PT, tgt, cfg=cfg)
+                if dev_pd < dev_don:
+                    p_lp, eps_star = p_pd, dev_pd
     else:
         from citizensassemblies_tpu.solvers.highs_backend import (
             solve_final_primal_lp,
@@ -154,32 +308,38 @@ def solve_final_primal_l2(
             p_lp, eps_star = solve_final_primal_lp(P, target)
     eps = eps_star + eps_margin
 
-    Pj = jnp.asarray(P, dtype=jnp.float32)
-    tj = jnp.asarray(target, dtype=jnp.float32)
-    # dual-gradient Lipschitz constant = σ_max(P)²/2, estimated by power
-    # iteration (shared with the PDHG core): the closed-form bound
-    # max_row_sum · max_col_sum / 2 overestimates σ² by orders of magnitude
-    # on expanded portfolios (thousands of panels all containing the popular
-    # agents), making the ascent step so small the spread never moved
-    from citizensassemblies_tpu.solvers.lp_pdhg import _power_norm
+    if fused_p is not None:
+        # the fused device call already ran the ascent (with its on-device
+        # convergence check) against the same floor it picked; only the
+        # float64 validation/blend below remains
+        p = fused_p
+    else:
+        Pj = jnp.asarray(P, dtype=jnp.float32)
+        tj = jnp.asarray(target, dtype=jnp.float32)
+        # dual-gradient Lipschitz constant = σ_max(P)²/2, estimated by power
+        # iteration (shared with the PDHG core): the closed-form bound
+        # max_row_sum · max_col_sum / 2 overestimates σ² by orders of magnitude
+        # on expanded portfolios (thousands of panels all containing the popular
+        # agents), making the ascent step so small the spread never moved
+        from citizensassemblies_tpu.solvers.lp_pdhg import _power_norm
 
-    sigma_sq = float(_power_norm(Pj)) ** 2
-    L = max(sigma_sq / 2.0, 1.0)
-    with log.timer("l2_dual_ascent"):
-        lam0 = jnp.zeros((2 * Pj.shape[1],), dtype=Pj.dtype)
-        # the jitted ascent runs under the no-implicit-transfer guard: every
-        # operand is materialized to a device array BEFORE the scope (the
-        # scalar conversions too — an eager convert_element_type on a python
-        # float inside the guard counts as an implicit upload, utils/guards)
-        from citizensassemblies_tpu.utils.guards import no_implicit_transfers
+        sigma_sq = float(_power_norm(Pj)) ** 2
+        L = max(sigma_sq / 2.0, 1.0)
+        with log.timer("l2_dual_ascent"):
+            lam0 = jnp.zeros((2 * Pj.shape[1],), dtype=Pj.dtype)
+            # the jitted ascent runs under the no-implicit-transfer guard: every
+            # operand is materialized to a device array BEFORE the scope (the
+            # scalar conversions too — an eager convert_element_type on a python
+            # float inside the guard counts as an implicit upload, utils/guards)
+            from citizensassemblies_tpu.utils.guards import no_implicit_transfers
 
-        eps_dev = jnp.asarray(eps, jnp.float32)
-        step_dev = jnp.asarray(1.0 / L, jnp.float32)
-        with no_implicit_transfers(cfg):
-            p, _lam = _min_norm_dual_ascent(Pj, tj, eps_dev, step_dev, lam0, iters)
-        # host materialization inside the timer: through a TPU tunnel,
-        # block_until_ready alone does not drain the pipeline (see bench.py)
-        p = np.asarray(p, dtype=np.float64)
+            eps_dev = jnp.asarray(eps, jnp.float32)
+            step_dev = jnp.asarray(1.0 / L, jnp.float32)
+            with no_implicit_transfers(cfg):
+                p, _lam = _min_norm_dual_ascent(Pj, tj, eps_dev, step_dev, lam0, iters)
+            # host materialization inside the timer: through a TPU tunnel,
+            # block_until_ready alone does not drain the pipeline (see bench.py)
+            p = np.asarray(p, dtype=np.float64)
     p = np.clip(p, 0.0, 1.0)
     s = p.sum()
     if s <= 0:

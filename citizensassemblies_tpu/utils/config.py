@@ -13,6 +13,7 @@ Here they are all lifted into one frozen dataclass.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,6 +192,30 @@ class Config:
     #: ~20 % of the whole solve
     pdhg_check_every: int = 128
 
+    # --- batched LP/QP engine (solvers/batch_lp.py) ---------------------------
+    #: fuse fleets of small independent LP/QP solves into padded, vmapped
+    #: device calls (``solvers/batch_lp.py``): polish-face screening in the
+    #: decomposition end-game, the fused XMIN L2 stage, the probe prescreen,
+    #: and sweep-level LP fleets. ``None`` = auto (on on accelerator
+    #: backends, off on CPU, where per-call dispatch overhead outweighs the
+    #: batching — same routing logic as the device masters); ``True``/
+    #: ``False`` force. With the engine off every call site runs its serial
+    #: path bit-identically.
+    lp_batch: Optional[bool] = None
+    #: cap on a padded bucket dimension: shapes are rounded up to a power of
+    #: two below the cap and to a multiple of the cap above it, so compiled
+    #: executables stay bounded (each distinct bucket compiles once) without
+    #: unbounded padding waste on large instances.
+    lp_batch_bucket_max: int = 4_096
+    #: batched device prescreen of per-candidate probe LPs
+    #: (``solvers/compositions.py``): an approximate device solve of the
+    #: whole candidate fleet witnesses clearly-loose candidates at a
+    #: float64-validated face point, pruning their host LPs. The screen can
+    #: only REDUCE the host-LP count — every candidate it cannot witness
+    #: loose still gets its float64 host confirm, so certification soundness
+    #: is unchanged.
+    lp_batch_screen: bool = True
+
     #: route the agent-space dual LP through the mesh-sharded device PDHG
     #: (``parallel/solver.py``) whenever more than one device is visible and
     #: the portfolio has at least this many rows — the regime where the C×n
@@ -212,10 +237,15 @@ class Config:
     #: budget the certified type-space profile ships with an explicit
     #: realization-ε statement (``Distribution.contract_ok = False``) instead
     #: of grinding a possibly multi-hour CG (the independent n=800 agent-space
-    #: cross-check did not finish in 3.5 h). 0 disables the budget; explicit
+    #: cross-check did not finish in 3.5 h). 0 — the default — disables the
+    #: budget entirely, so the out-of-contract ε-wide fallback is strictly
+    #: OPT-IN (ADVICE r5 #1, second half): an operator who wants the bounded
+    #: wall-clock sets a positive budget explicitly and thereby accepts that
+    #: a budget expiry ships a flagged ``contract_ok=False`` result — it can
+    #: no longer ship silently under a default. Explicit
     #: ``force_agent_space`` / warm-start runs are never budgeted (they have
     #: no fallback to ship).
-    agent_space_budget_s: float = 600.0
+    agent_space_budget_s: float = 0.0
 
     # --- backends -------------------------------------------------------------
     #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),

@@ -1,0 +1,384 @@
+"""The batched shape-bucketed LP/QP engine (``solvers/batch_lp.py``).
+
+Contracts pinned here:
+
+* **Batch-vs-serial parity** — a fleet solved by the vmapped engine matches
+  the serial PDHG solver per instance (same iteration body, two dispatch
+  shapes), and a full LEXIMIN run with the engine on certifies the same
+  values/ε as the engine-off run on flagship-shaped and household fixtures.
+* **Per-instance convergence masks** — an easy instance sharing a bucket
+  with a hard one is select-frozen at ITS OWN convergence: same solution
+  and same recorded iteration count as when solved alone.
+* **Warm-start slots survive a bucket re-pad** — a caller-keyed slot saved
+  at one column count is re-padded into a larger bucket when the instance
+  grows, including the structural ε tail variable.
+* **Prescreen soundness** — the device probe prescreen never prunes a
+  candidate the float64 host LP would certify tight: every pruned candidate
+  is verified genuinely loose by an exact host solve.
+* **Sharded sweeps** — the mesh-sharded batch axis returns the same
+  solutions as the single-device engine (8-device virtual CPU mesh).
+* **Serial fallback** — with ``lp_batch`` off, no engine counter appears:
+  the call sites run their serial paths untouched.
+"""
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.solvers.batch_lp import (
+    BatchLP,
+    clear_warm_slots,
+    final_primal_batch_lp,
+    lp_batch_enabled,
+    solve_lp_batch,
+    two_sided_master_batch_lp,
+)
+from citizensassemblies_tpu.solvers.lp_pdhg import solve_lp
+from citizensassemblies_tpu.utils.config import default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+CFG_ON = default_config().replace(lp_batch=True)
+CFG_OFF = default_config().replace(lp_batch=False)
+
+
+def _final_primal_fleet(n_inst=6, seed=0):
+    """Feasible final-ε LPs of varied small shapes (targets realizable)."""
+    rng = np.random.default_rng(seed)
+    fleet = []
+    for i in range(n_inst):
+        C, n = 18 + 4 * i, 9 + i
+        P = rng.random((C, n)) < 0.5
+        P[:n, :n] |= np.eye(n, dtype=bool)
+        q = rng.random(C)
+        q /= q.sum()
+        fleet.append(final_primal_batch_lp(P, P.T.astype(np.float64) @ q))
+    return fleet
+
+
+def test_batch_matches_serial_per_instance():
+    fleet = _final_primal_fleet()
+    log = RunLog(echo=False)
+    batch = solve_lp_batch(fleet, cfg=CFG_ON, log=log, max_iters=30_000)
+    for inst, sol in zip(fleet, batch):
+        ser = solve_lp(inst.c, inst.G, inst.h, inst.A, inst.b, cfg=CFG_ON)
+        assert sol.ok and ser.ok
+        assert abs(sol.objective - ser.objective) <= 1e-4
+        assert sol.x.shape == ser.x.shape  # real sizes, bucket pad stripped
+        assert sol.lam.shape == ser.lam.shape
+    # solves-per-dispatch: every instance solved, ≤ one dispatch per bucket
+    assert log.counters["lp_batch_solves"] == len(fleet)
+    n_buckets = sum(
+        1 for k in log.counters if k.startswith("lp_batch_compiles_")
+    )
+    assert log.counters["lp_batch_dispatches"] == n_buckets
+
+
+def test_convergence_mask_freezes_early_finisher():
+    """An easy lane bucketed with a hard one converges to its OWN result:
+    identical solution and identical recorded iteration count as solo."""
+    rng = np.random.default_rng(3)
+    n = 10
+    P_easy = np.eye(n, dtype=bool)  # trivial: p = t realizes exactly
+    t_easy = np.full(n, 1.0 / n)
+    easy = final_primal_batch_lp(P_easy, t_easy)
+    C = 10  # same shape bucket as easy (n+... rows, C+1 cols)
+    P_hard = rng.random((C, n)) < 0.5
+    t_hard = np.clip(
+        P_hard.T.astype(np.float64) @ np.full(C, 1.0 / C)
+        + rng.normal(0, 5e-3, n),
+        0.0,
+        1.0,
+    )
+    hard = final_primal_batch_lp(P_hard, t_hard)
+    solo = solve_lp_batch([easy], cfg=CFG_ON, max_iters=30_000)[0]
+    both = solve_lp_batch([easy, hard], cfg=CFG_ON, max_iters=30_000)
+    assert both[0].ok
+    assert both[0].iters == solo.iters  # frozen at its own convergence
+    np.testing.assert_allclose(both[0].x, solo.x, atol=1e-6)
+    # the hard lane genuinely ran longer — the mask wasn't a global stop
+    assert both[1].iters >= both[0].iters
+
+
+def test_warm_slots_survive_bucket_repad():
+    """A caller-keyed warm slot saved at one column bucket re-pads into a
+    larger bucket when the instance grows, ε tail slot included, and the
+    warm call converges at least as fast as the cold one."""
+    clear_warm_slots("test_repad")
+    rng = np.random.default_rng(4)
+    T, C = 12, 28  # C+1 = 29 → bucket 32
+    MT = rng.uniform(0.0, 1.0, (T, C))
+    v = MT @ rng.dirichlet(np.ones(C))
+    log = RunLog(echo=False)
+    first = solve_lp_batch(
+        [two_sided_master_batch_lp(MT, v)], cfg=CFG_ON, log=log,
+        warm_key="test_repad", max_iters=40_000,
+    )[0]
+    assert first.ok
+    # grow past the bucket boundary: 28 → 40 columns ⇒ bucket 32 → 64
+    MT2 = np.concatenate([MT, rng.uniform(0.0, 1.0, (T, 12))], axis=1)
+    log2 = RunLog(echo=False)
+    warm = solve_lp_batch(
+        [two_sided_master_batch_lp(MT2, v)], cfg=CFG_ON, log=log2,
+        warm_key="test_repad", max_iters=40_000,
+    )[0]
+    assert warm.ok
+    assert log2.counters.get("lp_batch_warm_hits", 0) == 1
+    assert len(warm.x) == MT2.shape[1] + 1  # real size, ε slot last
+    # the grown problem keeps the old columns, so the re-padded iterate is
+    # near-feasible: it must not be slower than a cold start
+    cold = solve_lp_batch(
+        [two_sided_master_batch_lp(MT2, v)], cfg=CFG_ON, max_iters=40_000
+    )[0]
+    assert warm.iters <= cold.iters
+    p_w = np.maximum(warm.x[:-1], 0.0)
+    p_w /= p_w.sum()
+    p_c = np.maximum(cold.x[:-1], 0.0)
+    p_c /= p_c.sum()
+    eps_w = float(np.abs(MT2 @ p_w - v).max())
+    eps_c = float(np.abs(MT2 @ p_c - v).max())
+    assert eps_w <= eps_c + 5e-5  # warm is exactness-neutral
+
+
+def test_probe_prescreen_never_prunes_a_tight_candidate():
+    """Soundness: every candidate the device screen prunes is verified
+    GENUINELY loose by the exact float64 host LP — i.e. the host probe
+    could never have confirmed it. Fuzzed over seeds; the screen is also
+    required to actually fire (prune something) on at least one seed, so
+    the assertion is not vacuous."""
+    from citizensassemblies_tpu.solvers.compositions import (
+        _SLACK,
+        _batched_probe_prescreen,
+    )
+    from citizensassemblies_tpu.solvers.lp_util import robust_linprog
+
+    pruned_total = 0
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        T, C = 8, 30
+        MT = rng.uniform(0.0, 1.0, (T, C))
+        p0 = rng.dirichlet(np.ones(C))
+        z = float((MT @ p0).min())
+        # the stage's optimal face: every type ≥ z − slack, Σp = 1
+        A_face = -MT
+        b_face = np.full(T, -(z - _SLACK))
+        objectives = MT.copy()  # one candidate per type
+        allowances = np.full(T, 1e-6)
+        probe_tol = 1e-7
+        loose = _batched_probe_prescreen(
+            objectives, A_face, b_face, z, probe_tol, allowances,
+            CFG_ON, log=RunLog(echo=False),
+        )
+        assert loose is not None
+        for i in np.nonzero(loose)[0]:
+            r = robust_linprog(
+                -objectives[i], A_ub=A_face, b_ub=b_face,
+                A_eq=np.ones((1, C)), b_eq=[1.0], bounds=[(0, None)] * C,
+            )
+            assert r.status == 0
+            host_max = float(-r.fun)
+            # host face max strictly above the certificate bound ⇒ the host
+            # probe would NOT have confirmed this candidate either
+            assert host_max > z + probe_tol + allowances[i], (
+                f"seed {seed}: pruned candidate {i} is tight "
+                f"(host max {host_max:.2e} ≤ bound)"
+            )
+        pruned_total += int(loose.sum())
+    assert pruned_total > 0  # the screen genuinely fired somewhere
+
+
+def test_prescreen_disabled_returns_none():
+    from citizensassemblies_tpu.solvers.compositions import (
+        _batched_probe_prescreen,
+    )
+
+    obj = np.eye(3)
+    out = _batched_probe_prescreen(
+        obj, -obj, np.zeros(3), 0.0, 1e-7, np.full(3, 1e-6),
+        CFG_ON.replace(lp_batch_screen=False), log=None,
+    )
+    assert out is None
+    out = _batched_probe_prescreen(
+        obj, -obj, np.zeros(3), 0.0, 1e-7, np.full(3, 1e-6),
+        CFG_OFF, log=None,
+    )
+    assert out is None
+
+
+def test_leximin_parity_engine_on_vs_off_flagship_shaped():
+    """Same certified leximin values and realization ε (within float64
+    noise) with the engine on vs off, on a small flagship-shaped (CG
+    type-space) fixture."""
+    from citizensassemblies_tpu.core.generator import skewed_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+
+    dense, space = featurize(skewed_instance(n=120, k=12, n_categories=3, seed=1))
+    log_on, log_off = RunLog(echo=False), RunLog(echo=False)
+    d_on = find_distribution_leximin(dense, space, cfg=CFG_ON, log=log_on)
+    d_off = find_distribution_leximin(dense, space, cfg=CFG_OFF, log=log_off)
+    assert (
+        float(np.abs(d_on.fixed_probabilities - d_off.fixed_probabilities).max())
+        <= 1e-9
+    )
+    assert abs(d_on.realization_dev - d_off.realization_dev) <= 1e-6
+    # the engine-off run must not have touched the engine at all
+    assert not any(k.startswith("lp_batch") for k in log_off.counters)
+
+
+def test_leximin_parity_engine_on_vs_off_households():
+    """Same parity contract on a household-quotient fixture (the
+    households_n1200 bench row's shape class, scaled down)."""
+    from citizensassemblies_tpu.core.generator import skewed_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+
+    dense, space = featurize(skewed_instance(n=80, k=10, n_categories=3, seed=2))
+    hh = np.arange(80) // 2
+    d_on = find_distribution_leximin(dense, space, cfg=CFG_ON, households=hh)
+    d_off = find_distribution_leximin(dense, space, cfg=CFG_OFF, households=hh)
+    assert (
+        float(np.abs(d_on.fixed_probabilities - d_off.fixed_probabilities).max())
+        <= 1e-9
+    )
+    assert abs(d_on.realization_dev - d_off.realization_dev) <= 1e-6
+
+
+def test_l2_fused_matches_serial_within_tolerance():
+    """The fused anchor+ascent device call reaches the same ε floor and an
+    equivalent spread as the serial two-dispatch path."""
+    from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
+
+    rng = np.random.default_rng(7)
+    C, n = 100, 24
+    P = rng.random((C, n)) < 0.35
+    P[:n, :n] |= np.eye(n, dtype=bool)
+    donor = np.zeros(C)
+    donor[:30] = rng.random(30)
+    donor /= donor.sum()
+    t = np.clip(
+        P[:30].T.astype(np.float64) @ donor[:30] + rng.normal(0, 2e-3, n),
+        0.0, 1.0,
+    )
+    log_s, log_f = RunLog(echo=False), RunLog(echo=False)
+    p_s, e_s = solve_final_primal_l2(
+        P, t, iters=4000, log=log_s, floor_donor=donor, cfg=CFG_OFF,
+        anchor_if_above=1e-4,
+    )
+    p_f, e_f = solve_final_primal_l2(
+        P, t, iters=4000, log=log_f, floor_donor=donor, cfg=CFG_ON,
+        anchor_if_above=1e-4,
+    )
+    assert log_f.counters.get("lp_batch_l2_fused") == 1
+    assert "l2_fused" in log_f.timers
+    assert "l2_eps_pdhg" in log_s.timers  # the serial path stayed serial
+    PT = P.T.astype(np.float64)
+    dev_s = float(np.abs(PT @ p_s - t).max())
+    dev_f = float(np.abs(PT @ p_f - t).max())
+    assert abs(e_f - e_s) <= 5e-5  # same float64 ε floor
+    assert dev_f <= dev_s + 1e-4  # equivalent realized deviation
+    # the fused spread is a genuine spread, not a degenerate point
+    assert (p_f > 1e-11).sum() >= (donor > 1e-11).sum()
+
+
+def test_polish_screen_certifies_at_the_bar(monkeypatch):
+    """The batched polish-face screen returns only arithmetically certified
+    mixtures: whatever candidate it accepts satisfies ‖Mp − v‖∞ ≤ bar in
+    float64 — the accept-bar semantics are identical to the serial path."""
+    import citizensassemblies_tpu.solvers.face_decompose as fd
+    from citizensassemblies_tpu.core.generator import skewed_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.solvers.cg_typespace import (
+        CompositionOracle,
+        _leximin_relaxation,
+        _slice_relaxation,
+    )
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+    monkeypatch.setattr(fd, "_POLISH_SCREEN_MIN_SUP", 0)
+    dense, _ = featurize(skewed_instance(n=120, k=12, n_categories=3, seed=2))
+    red = TypeReduction(dense)
+    v_relax, _x = _leximin_relaxation(red, RunLog(echo=False))
+    seeds = _slice_relaxation(
+        v_relax * red.msize.astype(np.float64), red, R=4
+    )
+    cfg = CFG_ON.replace(decomp_host_master_max_types=0)
+    log = RunLog(echo=False)
+    C_sup, probs, eps, _solves = fd.realize_profile(
+        red, v_relax, list(seeds), CompositionOracle(red), 1e-5,
+        log=log, max_rounds=3, use_pdhg=True, cfg=cfg,
+    )
+    # the screen ran as ONE fused dispatch per polish attempt
+    assert log.counters.get("lp_batch_dispatches", 0) >= 1
+    hit = log.counters.get("lp_batch_polish_hit", 0)
+    miss = log.counters.get("lp_batch_polish_miss", 0)
+    assert hit + miss >= 1
+    # float64 arithmetic certificate of whatever was returned
+    mix = probs @ (C_sup.astype(np.float64) / red.msize[None, :])
+    assert float(np.abs(mix - v_relax).max()) <= eps + 1e-12
+
+
+def test_sweep_sharded_matches_single_device():
+    """The mesh-sharded batch axis (8 virtual CPU devices) returns the same
+    per-instance solutions as the single-device engine."""
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs the multi-device virtual mesh")
+    from citizensassemblies_tpu.parallel.mesh import default_mesh
+    from citizensassemblies_tpu.parallel.sweep import sweep_final_primal_eps
+
+    rng = np.random.default_rng(11)
+    ports, tgts = [], []
+    for i in range(5):
+        C, n = 20 + 4 * i, 10 + i
+        P = rng.random((C, n)) < 0.5
+        q = rng.random(C)
+        q /= q.sum()
+        ports.append(P)
+        tgts.append(P.T.astype(np.float64) @ q)
+    log = RunLog(echo=False)
+    sharded = sweep_final_primal_eps(
+        ports, tgts, cfg=CFG_ON, log=log, mesh=default_mesh()
+    )
+    single = sweep_final_primal_eps(ports, tgts, cfg=CFG_ON, mesh=None)
+    assert log.counters.get("lp_batch_dispatches", 0) >= 1
+    for (p_sh, e_sh), (p_si, e_si) in zip(sharded, single):
+        np.testing.assert_allclose(p_sh, p_si, atol=1e-5)
+        assert abs(e_sh - e_si) <= 1e-5
+        assert e_sh <= 1e-4  # realizable targets: the downward deficit ~0
+
+
+def test_lp_batch_enabled_resolution():
+    """Tri-state knob: forced on/off wins; auto follows the backend (CPU in
+    this suite ⇒ auto-off)."""
+    assert lp_batch_enabled(CFG_ON)
+    assert not lp_batch_enabled(CFG_OFF)
+    assert not lp_batch_enabled(default_config())  # auto on CPU
+
+
+def test_empty_and_single_instance_batches():
+    assert solve_lp_batch([], cfg=CFG_ON) == []
+    inst = _final_primal_fleet(n_inst=1)[0]
+    sol = solve_lp_batch([inst], cfg=CFG_ON, max_iters=20_000)[0]
+    ser = solve_lp(inst.c, inst.G, inst.h, inst.A, inst.b, cfg=CFG_ON)
+    assert sol.ok
+    assert abs(sol.objective - ser.objective) <= 1e-4
+
+
+def test_generic_batchlp_with_inequalities_only():
+    """A bucket mixing instances with different row counts still pads
+    soundly (zero rows are 0 ≤ 0 constraints)."""
+    rng = np.random.default_rng(5)
+    fleet = []
+    for i in range(3):
+        nv, m1 = 6, 4 + i
+        G = rng.uniform(-1.0, 1.0, (m1, nv))
+        x_feas = rng.uniform(0.1, 1.0, nv)
+        h = G @ x_feas + 0.1
+        c = rng.uniform(0.0, 1.0, nv)  # c ≥ 0 and x ≥ 0 ⇒ bounded below
+        A = np.ones((1, nv))
+        b = np.array([x_feas.sum()])
+        fleet.append(BatchLP(c=c, G=G, h=h, A=A, b=b))
+    sols = solve_lp_batch(fleet, cfg=CFG_ON, max_iters=40_000, common_bucket=True)
+    for inst, sol in zip(fleet, sols):
+        ser = solve_lp(inst.c, inst.G, inst.h, inst.A, inst.b, cfg=CFG_ON)
+        assert abs(sol.objective - ser.objective) <= 5e-4
