@@ -7,9 +7,22 @@ test:
 bench:
 	python bench.py
 
-# graftlint (the repo's JAX-invariant checker — R1..R6, see README "Static
-# analysis & guard rails") plus a ruff style baseline when ruff is installed.
-# graftlint is stdlib-only, so this target needs no accelerator stack.
+# graftlint (the repo's JAX-invariant checker — R1..R7, see README "Static
+# analysis & guard rails") over the package AND bench.py/tests/, plus a ruff
+# style baseline when ruff is installed. graftlint is stdlib-only, so this
+# target needs no accelerator stack.
 lint:
-	python -m citizensassemblies_tpu.lint citizensassemblies_tpu/
+	python -m citizensassemblies_tpu.lint citizensassemblies_tpu/ bench.py tests/
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; else echo "ruff not installed; style baseline skipped (ruff.toml)"; fi
+
+# graftcheck-IR (lint/ir.py): trace every registered jitted core, verify
+# callback/f64/donation invariants at the jaxpr/HLO level and ratchet the
+# static cost model against ANALYSIS_BUDGET.json. CPU-traceable — the same
+# env pinning as `test` keeps the TPU tunnel out of the way. The measured-vs-
+# budget diff lands in IR_BUDGET_DIFF.json (uploaded as a CI artifact).
+check-ir:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m citizensassemblies_tpu.lint --ir --diff-out IR_BUDGET_DIFF.json
+
+# deliberate ratchet move: re-measure every core and rewrite the budget
+update-ir-budget:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m citizensassemblies_tpu.lint --ir --update-budget

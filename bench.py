@@ -595,6 +595,14 @@ def main() -> None:
         "vs_baseline": round(elapsed / baseline, 4),
         "detail": detail,
     }
+    # budget provenance: which ANALYSIS_BUDGET.json ratchet state this
+    # evidence row was measured against (sha + core count + jax version)
+    try:
+        from citizensassemblies_tpu.lint.ir import budget_provenance
+
+        result["ir_budget"] = budget_provenance()
+    except Exception:  # provenance must never kill a bench run
+        result["ir_budget"] = {"error": "unavailable"}
     print(json.dumps(result))
 
     # Durable evidence (VERDICT r5 missing #1): the driver records only the
@@ -626,6 +634,8 @@ def main() -> None:
         detail_path = f"(unwritable: {exc})"
 
     summary = {"detail_file": os.path.basename(str(detail_path))}
+    if isinstance(result.get("ir_budget"), dict) and "sha256" in result["ir_budget"]:
+        summary["ir_budget"] = result["ir_budget"]["sha256"]
     flag = {}
     for key in (
         "sf_e_skewed", "sf_e_skewed_seed0", "sf_e_skewed_seed2",

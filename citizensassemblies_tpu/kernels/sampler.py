@@ -38,6 +38,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+
 NEG_INF = -1e30
 
 
@@ -112,9 +114,12 @@ def _sampler_kernel(
         remaining = jnp.dot(alive, A, preferred_element_type=jnp.float32)
         deficit = qmin - selected
         # a cell that cannot reach its lower quota kills the draw
-        # (legacy.py:55-57,132-137)
+        # (legacy.py:55-57,132-137). Bool→f32 casts instead of
+        # where(pred, 1.0, 0.0): two weak python-float branches resolve to
+        # f64 under an enable_x64 trace, which breaks the f32 loop carry
+        # (the IR verifier retraces every core under x64 — lint/ir.py IR2)
         starved = jnp.max(
-            jnp.where(deficit > remaining, 1.0, 0.0), axis=1, keepdims=True
+            (deficit > remaining).astype(jnp.float32), axis=1, keepdims=True
         )
         eligible = (remaining > 0.5) & (qmax > 0.5)
         ratio = jnp.where(eligible, deficit / jnp.maximum(remaining, 1.0), NEG_INF)
@@ -138,14 +143,14 @@ def _sampler_kernel(
 
         # purge cascade: cells of the pick that just hit their upper quota
         # evict all their members (legacy.py:103-120,47-62) — one matmul
-        purged = jnp.where(
-            (jnp.abs(selected - qmax) < 0.5) & (person_feats > 0.5), 1.0, 0.0
-        )
+        purged = (
+            (jnp.abs(selected - qmax) < 0.5) & (person_feats > 0.5)
+        ).astype(jnp.float32)
         kill = jnp.dot(purged, AT, preferred_element_type=jnp.float32)
         # evict the pick's whole household (distinct ids ⇒ just the pick)
         hh_person = jnp.sum(p_oh * hh, axis=1, keepdims=True)
-        alive = alive * jnp.where(kill > 0.5, 0.0, 1.0)
-        alive = alive * jnp.where(jnp.abs(hh - hh_person) < 0.5, 0.0, 1.0)
+        alive = alive * (kill <= 0.5).astype(jnp.float32)
+        alive = alive * (jnp.abs(hh - hh_person) >= 0.5).astype(jnp.float32)
 
         failed = jnp.maximum(failed, jnp.maximum(starved, 1.0 - has_member))
         # masked select into the carried panel buffer: a dynamic-offset
@@ -159,7 +164,7 @@ def _sampler_kernel(
     panels_ref[:] = panel
     # final lower-quota audit (check_min_cats, legacy.py:160-168)
     shortfall = jnp.max(
-        jnp.where(selected < qmin, 1.0, 0.0), axis=1, keepdims=True
+        (selected < qmin).astype(jnp.float32), axis=1, keepdims=True
     )
     ok = 1.0 - jnp.maximum(failed, shortfall)
     ok_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), ok_ref.shape)
@@ -209,6 +214,28 @@ def _pallas_sample(
         interpret=interpret,
     )(seed, A_pad, AT_pad, qmin_pad, qmax_pad, scores, hh)
     return panels[:, :k], ok[:, 0].astype(bool)
+
+
+@register_ir_core("kernels.pallas_sampler")
+def _ir_pallas_sampler() -> IRCase:
+    """The fused draw at one minimum-padded shape, in interpret mode so the
+    kernel lowers on CPU. The murmur3 RNG is in-register by design — the IR
+    check pins that no host-noise callback ever sneaks into the draw."""
+    S = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+    n_pad = F_pad = k_pad = 128
+    B = block_b = 8
+    return IRCase(
+        fn=_pallas_sample,
+        args=(
+            S((n_pad, F_pad), f32), S((F_pad, n_pad), f32),
+            S((1, F_pad), f32), S((1, F_pad), f32),
+            S((B, n_pad), f32), S((1, n_pad), f32), S((1,), i32),
+        ),
+        static=dict(
+            B=B, block_b=block_b, k=12, n=100, k_pad=k_pad, interpret=True
+        ),
+    )
 
 
 #: VMEM budget for the per-program working set (bytes). Real VMEM is ~16 MB

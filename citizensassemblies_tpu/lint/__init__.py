@@ -1,21 +1,34 @@
-"""graftlint — AST-based static analysis for this repo's JAX invariants.
+"""graftlint — static analysis + IR-level verification of the JAX invariants.
 
 The flagship speedups rest on invariants nothing in the type system enforces:
 jitted cores must stay host-sync-free, jits must be constructed once (not per
 call or per loop iteration), donated buffers must never be read after the
 donating call, the float64 certification arithmetic must not silently
-downcast, Python control flow must not branch on tracers, and every
-``Config`` knob must be genuinely read and documented. graftlint walks the
-package and enforces all of it, with ``file:line`` reports and an explicit
-suppression syntax (``# graftlint: disable=R1 -- reason``).
+downcast, Python control flow must not branch on tracers, every ``Config``
+knob must be genuinely read and documented, and worker threads must not write
+shared state unlocked. graftlint walks the package and enforces all of it
+(rules R1–R7), with ``file:line`` reports and an explicit suppression syntax
+(``# graftlint: disable=R1 -- reason``; an unused suppression is itself an
+error).
 
-Run it as ``python -m citizensassemblies_tpu.lint [paths...]`` or via
-``make lint``; the test suite runs the same pass over the real package
-(``tests/test_lint.py``), so a new violation fails tier-1.
+A second, compiler-level pass — graftcheck-IR (``lint.ir`` + the core
+registry in ``lint.registry``) — traces every registered hot jitted core via
+``jax.make_jaxpr`` / AOT ``lower().compile()`` and verifies what the AST
+cannot see: no host-callback primitive inside a core (IR1), dtype discipline
+at the IR level (IR2), declared donations realized as input/output aliases in
+the compiled executable (IR3), and a static cost model (XLA ``cost_analysis``
+FLOPs/bytes + jaxpr primitive histograms) ratcheted against the committed
+``ANALYSIS_BUDGET.json`` (IR4).
 
-The package is deliberately dependency-free (stdlib ``ast`` only — no jax
-import), so linting is fast and runs anywhere, including editors and CI
-runners without an accelerator stack.
+Run the AST pass as ``python -m citizensassemblies_tpu.lint [paths...]``
+(``make lint``) and the IR pass as ``python -m citizensassemblies_tpu.lint
+--ir`` (``make check-ir``); the test suite runs both over the real package
+(``tests/test_lint.py``, ``tests/test_ir_check.py``), so a new violation
+fails tier-1. ``--format json`` emits the stable machine schema.
+
+The AST side is deliberately dependency-free (stdlib ``ast`` only — no jax
+import), so linting is fast and runs anywhere; the IR side traces on
+whatever backend is present (plain CPU in CI).
 """
 
 from citizensassemblies_tpu.lint.engine import (

@@ -1,17 +1,34 @@
 """graftlint CLI: ``python -m citizensassemblies_tpu.lint [paths...]``.
 
 Exit code 0 when clean, 1 on violations — pipeline-ready. With no paths the
-package that contains this module is linted.
+package that contains this module is linted. ``--ir`` switches to the
+jaxpr/HLO-level verifier (``lint.ir``): every registered hot core is traced
+and checked for callbacks, f64 leaks, dropped donations and cost-budget
+regressions against ``ANALYSIS_BUDGET.json`` (``--update-budget`` re-ratchets
+the file deliberately). ``--format json`` emits the stable machine schema for
+either pass.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import sys
 from pathlib import Path
 from typing import Optional, Sequence
 
 from citizensassemblies_tpu.lint.engine import lint_paths, render_report
+
+
+def _ast_report_as_json(report) -> dict:
+    """Stable schema shared with the IR pass: rule, path, line, message."""
+    return {
+        "ok": report.ok,
+        "files": report.files,
+        "suppressed": report.suppressed,
+        "violations": [dataclasses.asdict(v) for v in report.violations],
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -20,8 +37,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         description=(
             "graftlint: static analysis of this repo's JAX invariants "
             "(R1 host-sync-in-jit, R2 jit-per-call, R3 donated-buffer-reuse, "
-            "R4 dtype-discipline, R5 tracer-branch, R6 config-knob-hygiene). "
-            "Suppress with '# graftlint: disable=R1 -- reason'."
+            "R4 dtype-discipline, R5 tracer-branch, R6 config-knob-hygiene, "
+            "R7 thread-discipline). Suppress with "
+            "'# graftlint: disable=R1 -- reason'; a suppression that matches "
+            "no finding is itself an error. --ir runs the jaxpr/HLO-level "
+            "verifier over the registered hot cores instead."
         ),
     )
     parser.add_argument(
@@ -35,10 +55,68 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument(
         "-q", "--quiet", action="store_true", help="print violations only"
     )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json: stable rule/path/line/message schema)",
+    )
+    parser.add_argument(
+        "--ir", action="store_true",
+        help="run the IR-level verifier (callbacks, f64, donation, budgets) "
+        "over the registered jitted cores instead of the AST rules",
+    )
+    parser.add_argument(
+        "--budget", type=Path, default=None,
+        help="cost-budget file for --ir (default: ANALYSIS_BUDGET.json at "
+        "the repo root)",
+    )
+    parser.add_argument(
+        "--update-budget", action="store_true",
+        help="with --ir: re-measure every core and REWRITE the budget file "
+        "(the deliberate ratchet move); IR1-IR3 still fail",
+    )
+    parser.add_argument(
+        "--diff-out", type=Path, default=None,
+        help="with --ir: write the measured-vs-budget diff JSON here "
+        "(the CI build artifact)",
+    )
     args = parser.parse_args(argv)
+
+    if args.update_budget and not args.ir:
+        parser.error("--update-budget requires --ir")
+    if args.ir:
+        if args.paths:
+            parser.error("--ir verifies the registered cores; paths are "
+                         "for the AST pass")
+        from citizensassemblies_tpu.lint.ir import (
+            budget_diff,
+            ir_report_as_json,
+            render_ir_report,
+            run_ir_checks,
+        )
+
+        report = run_ir_checks(
+            budget_path=args.budget, update_budget=args.update_budget
+        )
+        if args.diff_out is not None:
+            args.diff_out.write_text(
+                json.dumps(budget_diff(report), indent=1, sort_keys=True) + "\n",
+                encoding="utf-8",
+            )
+        if args.format == "json":
+            print(json.dumps(ir_report_as_json(report), indent=1))
+        else:
+            rendered = render_ir_report(report)
+            if args.quiet:
+                rendered = "\n".join(v.render() for v in report.violations)
+            if rendered:
+                print(rendered)
+        return 0 if report.ok else 1
 
     paths = args.paths or [Path(__file__).resolve().parent.parent]
     report = lint_paths(paths, readme=args.readme)
+    if args.format == "json":
+        print(json.dumps(_ast_report_as_json(report), indent=1))
+        return 0 if report.ok else 1
     rendered = render_report(report)
     if args.quiet:
         rendered = "\n".join(v.render() for v in report.violations)

@@ -93,9 +93,18 @@ class ConfigKnobRule:
         if config_mod is None:
             return []
 
+        # "read somewhere" means read IN THE PACKAGE that owns the Config:
+        # with the lint scope extended to bench.py and tests/, a knob whose
+        # only consumer is a test would otherwise stop counting as dead
+        pkg_root = config_mod.rel.replace("\\", "/").split("/", 1)[0]
         reads: Set[str] = set()
         for mod in modules:
             if mod is config_mod:
+                continue
+            rel = mod.rel.replace("\\", "/")
+            if "/" in config_mod.rel.replace("\\", "/") and not rel.startswith(
+                pkg_root + "/"
+            ):
                 continue
             reads |= _reads_in_module(mod)
 

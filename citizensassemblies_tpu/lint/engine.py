@@ -14,17 +14,23 @@ Suppression syntax (the acceptance contract requires a *reason*):
 * ``# graftlint: disable=R1,R4 -- reason``    several rules at once;
 * ``# graftlint: disable-file=R6 -- reason``  whole-file suppression.
 
-A disable comment *without* a reason is itself reported (rule R0) — silent
-suppressions are how invariant checkers rot.
+Directives are parsed from real COMMENT tokens (``tokenize``), so a
+directive spelled inside a string literal — a lint self-test fixture, a
+docstring example like the ones above — is inert. Two directive hygiene
+checks ride the engine itself (both R0): a disable *without a reason*, and
+an *unused* disable that matches no finding (ruff's unused-noqa, so stale
+suppressions cannot accumulate as the rules or the code improve).
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import re
+import tokenize
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,38 +75,68 @@ _SUPPRESS_RE = re.compile(
 
 
 @dataclasses.dataclass
+class _Directive:
+    """One parsed ``# graftlint: disable…`` comment."""
+
+    line: int
+    rules: Set[str]
+    file_wide: bool
+    has_reason: bool
+    text: str  # "disable" / "disable-file", for messages
+    used: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
 class _Suppressions:
-    by_line: Dict[int, Set[str]]  # line -> rule ids suppressed there
-    file_wide: Set[str]
-    missing_reason: List[Tuple[int, str]]  # (line, directive) without a reason
+    directives: List[_Directive]
 
     def covers(self, rule: str, line: int) -> bool:
-        if rule in self.file_wide:
-            return True
-        rules = self.by_line.get(line)
-        return rules is not None and rule in rules
+        """Does any directive suppress ``rule`` at ``line``? Marks the
+        matching directives used, which is what the unused-suppression
+        check reads afterwards."""
+        hit = False
+        for d in self.directives:
+            if rule not in d.rules:
+                continue
+            # a line directive covers its own line and the next one, so it
+            # can annotate a long statement from the line above
+            if d.file_wide or line in (d.line, d.line + 1):
+                d.used.add(rule)
+                hit = True
+        return hit
 
 
-def _parse_suppressions(lines: Sequence[str]) -> _Suppressions:
-    by_line: Dict[int, Set[str]] = {}
-    file_wide: Set[str] = set()
-    missing: List[Tuple[int, str]] = []
-    for i, raw in enumerate(lines, start=1):
-        m = _SUPPRESS_RE.search(raw)
+def _comment_tokens(text: str) -> List[Tuple[int, str]]:
+    """(line, comment_text) for every real COMMENT token. Tokenizing keeps
+    directives inside string literals inert; on files tokenize cannot digest
+    (rare encoding edge cases) fall back to raw line scanning."""
+    try:
+        return [
+            (tok.start[0], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return list(enumerate(text.splitlines(), start=1))
+
+
+def _parse_suppressions(text: str) -> _Suppressions:
+    directives: List[_Directive] = []
+    for line, comment in _comment_tokens(text):
+        m = _SUPPRESS_RE.search(comment)
         if not m:
             continue
-        directive, rule_list, reason = m.group(1), m.group(2), m.group(3)
-        rules = {r.strip() for r in rule_list.split(",")}
-        if not reason:
-            missing.append((i, directive))
-        if directive == "disable-file":
-            file_wide |= rules
-        else:
-            # the comment covers its own line and the next one, so it can
-            # annotate a long statement from the line above
-            by_line.setdefault(i, set()).update(rules)
-            by_line.setdefault(i + 1, set()).update(rules)
-    return _Suppressions(by_line=by_line, file_wide=file_wide, missing_reason=missing)
+        kind, rule_list, reason = m.group(1), m.group(2), m.group(3)
+        directives.append(
+            _Directive(
+                line=line,
+                rules={r.strip() for r in rule_list.split(",")},
+                file_wide=kind == "disable-file",
+                has_reason=bool(reason),
+                text=kind,
+            )
+        )
+    return _Suppressions(directives=directives)
 
 
 def load_module(path: Path, root: Optional[Path] = None) -> Optional[ModuleSource]:
@@ -138,13 +174,14 @@ def iter_python_files(paths: Iterable[Path]) -> List[Path]:
 
 
 def all_rules():
-    """The registered rule set, R1..R6 (R0 is emitted by the engine itself)."""
+    """The registered rule set, R1..R7 (R0 is emitted by the engine itself)."""
     from citizensassemblies_tpu.lint.config_rule import ConfigKnobRule
     from citizensassemblies_tpu.lint.rules import (
         DonatedBufferReuseRule,
         DtypeDisciplineRule,
         HostSyncInJitRule,
         JitConstructionRule,
+        ThreadDisciplineRule,
         TracerBranchRule,
     )
 
@@ -155,6 +192,7 @@ def all_rules():
         DtypeDisciplineRule(),
         TracerBranchRule(),
         ConfigKnobRule(),
+        ThreadDisciplineRule(),
     ]
 
 
@@ -189,8 +227,8 @@ def lint_paths(
             for mod in modules:
                 raw.extend(rule.check_module(mod))
 
-    # apply suppressions + report reason-less directives
-    sup_by_rel = {m.rel: _parse_suppressions(m.lines) for m in modules}
+    # apply suppressions + report directive hygiene (missing reason, unused)
+    sup_by_rel = {m.rel: _parse_suppressions(m.text) for m in modules}
     kept: List[Violation] = []
     suppressed = 0
     for v in sorted(raw, key=lambda v: (v.path, v.line, v.col, v.rule)):
@@ -200,17 +238,30 @@ def lint_paths(
             continue
         kept.append(v)
     for m in modules:
-        for line, directive in sup_by_rel[m.rel].missing_reason:
-            kept.append(
-                Violation(
-                    path=m.rel, line=line, col=0, rule="R0",
-                    name="suppression-without-reason",
-                    message=(
-                        f"'graftlint: {directive}=' needs a reason "
-                        "(append ' -- why this is safe')"
-                    ),
+        for d in sup_by_rel[m.rel].directives:
+            if not d.has_reason:
+                kept.append(
+                    Violation(
+                        path=m.rel, line=d.line, col=0, rule="R0",
+                        name="suppression-without-reason",
+                        message=(
+                            f"'graftlint: {d.text}=' needs a reason "
+                            "(append ' -- why this is safe')"
+                        ),
+                    )
                 )
-            )
+            for rule in sorted(d.rules - d.used):
+                kept.append(
+                    Violation(
+                        path=m.rel, line=d.line, col=0, rule="R0",
+                        name="unused-suppression",
+                        message=(
+                            f"'graftlint: {d.text}={rule}' suppresses no "
+                            "finding — remove the stale directive (mirrors "
+                            "ruff's unused-noqa)"
+                        ),
+                    )
+                )
     kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return LintReport(violations=kept, suppressed=suppressed, files=len(files))
 

@@ -1,0 +1,531 @@
+"""graftcheck-IR: jaxpr/HLO-level invariant verification with cost budgets.
+
+The AST linter (``lint.rules``, R1–R7) sees *source text*; this pass sees
+what the compiler actually builds. Every core in the registry
+(``lint.registry``) is traced with ``jax.make_jaxpr`` and AOT-compiled via
+``fn.lower(...).compile()`` on whatever backend is present (CPU in CI), and
+four invariant classes are checked against the IR:
+
+* **IR1 callback-in-core** — no ``pure_callback`` / ``io_callback`` /
+  ``debug_callback`` / host-callback primitive anywhere in a core's jaxpr
+  (recursively through pjit/scan/while/cond sub-jaxprs). A callback inside a
+  hot core serializes the device pipeline on the host every dispatch.
+* **IR2 f64-in-core** — the core is retraced under ``enable_x64`` and every
+  equation output aval is checked: a *strong* float64 anywhere outside the
+  cert-tagged cores means an explicit f64 request survived into the program
+  (with x64 off it silently truncates to f32 — the bug R4 can only see when
+  it is spelled ``jnp.float64`` in source). Weak-typed f64 scalars (python
+  floats) are exempt — they canonicalize to f32 in the real x64-off runtime.
+  Cert-tagged cores (``allow_f64``) invert the check: no strong-f64 →
+  float32 ``convert_element_type`` narrowing inside them.
+* **IR3 dropped-donation** — the lowered module must realize exactly the
+  declared number of input→output buffer aliases (``tf.aliasing_output`` in
+  the StableHLO). jax only *warns* when a donation is unusable; here the
+  silently-dropped donation is a named FAIL.
+* **IR4 cost-budget** — XLA ``cost_analysis()`` FLOPs + bytes accessed plus
+  the jaxpr primitive histogram, checked against the committed
+  ``ANALYSIS_BUDGET.json`` with a tolerance ratchet: CI fails when a core's
+  cost regresses beyond ``(1 + tolerance)×`` its budget or a new primitive
+  class appears; ``--update-budget`` regenerates the file deliberately.
+
+Run as ``python -m citizensassemblies_tpu.lint --ir`` (or ``make check-ir``);
+reports use graftlint's ``file:line`` contract, pointing at each core's
+registration site.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from citizensassemblies_tpu.lint.engine import Violation
+from citizensassemblies_tpu.lint.registry import CoreEntry, IRCase, collect
+
+#: default headroom of the cost ratchet: measured ≤ budget × (1 + tolerance).
+#: Wide enough to absorb minor XLA-version drift, tight enough that a doubled
+#: matvec or an un-fused pass shows up.
+DEFAULT_TOLERANCE = 0.25
+
+#: primitives that execute host code from inside a compiled program
+_CALLBACK_PRIMS = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "callback",
+        "outside_call",  # legacy host_callback
+    }
+)
+
+#: the default committed budget file, at the repo root next to the package
+BUDGET_PATH = Path(__file__).resolve().parent.parent.parent / "ANALYSIS_BUDGET.json"
+
+
+@dataclasses.dataclass
+class CoreReport:
+    """Verification outcome for one registered core."""
+
+    name: str
+    path: str
+    line: int
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+    measured: Optional[Dict[str, Any]] = None  # {"flops", "bytes", "prims"}
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+@dataclasses.dataclass
+class IRReport:
+    """The whole pass: per-core reports plus budget bookkeeping."""
+
+    cores: List[CoreReport]
+    budget_path: str
+    tolerance: float
+    updated: bool = False
+
+    @property
+    def violations(self) -> List[Violation]:
+        return [v for c in self.cores for v in c.violations]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# --- jaxpr walking ----------------------------------------------------------
+
+
+def _sub_jaxprs(value):
+    """Yield Jaxpr objects reachable from one eqn param value."""
+    items = value if isinstance(value, (list, tuple)) else [value]
+    for item in items:
+        if hasattr(item, "jaxpr"):  # ClosedJaxpr
+            yield item.jaxpr
+        elif hasattr(item, "eqns"):  # Jaxpr
+            yield item
+
+
+def iter_eqns(jaxpr):
+    """Every equation in ``jaxpr``, recursing through sub-jaxprs (pjit
+    bodies, scan/while carries, cond branches, pallas kernels, ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for value in eqn.params.values():
+            for sub in _sub_jaxprs(value):
+                yield from iter_eqns(sub)
+
+
+def primitive_histogram(jaxpr) -> Dict[str, int]:
+    hist: Dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        hist[eqn.primitive.name] = hist.get(eqn.primitive.name, 0) + 1
+    return hist
+
+
+def _strong_f64_prims(jaxpr) -> List[str]:
+    """Primitive names producing a strong-typed float64 output."""
+    out: List[str] = []
+    for eqn in iter_eqns(jaxpr):
+        for var in eqn.outvars:
+            aval = var.aval
+            if (
+                hasattr(aval, "dtype")
+                and str(aval.dtype) == "float64"
+                and not getattr(aval, "weak_type", False)
+            ):
+                out.append(eqn.primitive.name)
+                break
+    return out
+
+
+def _f64_narrowing_count(jaxpr) -> int:
+    """``convert_element_type`` equations narrowing strong f64 → f32."""
+    count = 0
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        ins = [v.aval for v in eqn.invars if hasattr(v.aval, "dtype")]
+        outs = [v.aval for v in eqn.outvars if hasattr(v.aval, "dtype")]
+        if not ins or not outs:
+            continue
+        if (
+            str(ins[0].dtype) == "float64"
+            and not getattr(ins[0], "weak_type", False)
+            and str(outs[0].dtype) == "float32"
+        ):
+            count += 1
+    return count
+
+
+# --- per-core verification --------------------------------------------------
+
+
+def _viol(entry: CoreEntry, rule: str, name: str, message: str) -> Violation:
+    return Violation(
+        path=entry.path, line=entry.line, col=0, rule=rule, name=name,
+        message=f"[{entry.name}] {message}",
+    )
+
+
+def _trace_jaxpr(case: IRCase, x64: bool):
+    import jax
+    from functools import partial
+
+    traced = partial(case.fn, **case.static) if case.static else case.fn
+    if x64:
+        with jax.experimental.enable_x64():
+            return jax.make_jaxpr(traced)(*case.args)
+    return jax.make_jaxpr(traced)(*case.args)
+
+
+def _cost_analysis(compiled) -> Dict[str, float]:
+    got = compiled.cost_analysis()
+    if isinstance(got, (list, tuple)):
+        got = got[0] if got else {}
+    return dict(got or {})
+
+
+def verify_core(
+    entry: CoreEntry,
+    budget: Optional[Dict[str, Any]],
+    tolerance: float,
+) -> CoreReport:
+    """Run IR1–IR4 for one registered core; never raises on check failures
+    (they become violations), only on infrastructure errors (a core that no
+    longer traces is reported as a violation too, with the exception text)."""
+    report = CoreReport(name=entry.name, path=entry.path, line=entry.line)
+    try:
+        case = entry.build()
+    except Exception as exc:  # noqa: BLE001 - reported, not raised
+        report.violations.append(
+            _viol(entry, "IR0", "untraceable-core", f"builder failed: {exc!r}")
+        )
+        return report
+
+    # --- trace (normal mode): callbacks + primitive histogram --------------
+    try:
+        closed = _trace_jaxpr(case, x64=False)
+    except Exception as exc:  # noqa: BLE001
+        report.violations.append(
+            _viol(entry, "IR0", "untraceable-core", f"make_jaxpr failed: {exc!r}")
+        )
+        return report
+    hist = primitive_histogram(closed.jaxpr)
+    for prim in sorted(set(hist) & _CALLBACK_PRIMS):
+        report.violations.append(
+            _viol(
+                entry, "IR1", "callback-in-core",
+                f"'{prim}' primitive inside the jitted core "
+                f"({hist[prim]}×) — host callbacks serialize the device "
+                "pipeline every dispatch; hoist the host work out of the core",
+            )
+        )
+
+    # --- dtype discipline under enable_x64 ----------------------------------
+    if case.x64_trace:
+        try:
+            closed64 = _trace_jaxpr(case, x64=True)
+        except Exception as exc:  # noqa: BLE001
+            report.violations.append(
+                _viol(
+                    entry, "IR2", "f64-in-core",
+                    f"core does not trace under enable_x64 ({exc!r}) — "
+                    "dtype-pin the offending literals (see kernels/sampler) "
+                    "or tag the registration x64_trace=False with a reason",
+                )
+            )
+        else:
+            if case.allow_f64:
+                narrowed = _f64_narrowing_count(closed64.jaxpr)
+                if narrowed:
+                    report.violations.append(
+                        _viol(
+                            entry, "IR2", "f64-narrowed-in-cert-core",
+                            f"{narrowed} float64→float32 convert_element_type "
+                            "inside a cert-tagged core — the certification "
+                            "arithmetic must stay float64 end to end",
+                        )
+                    )
+            else:
+                bad = sorted(set(_strong_f64_prims(closed64.jaxpr)))
+                if bad:
+                    report.violations.append(
+                        _viol(
+                            entry, "IR2", "f64-in-core",
+                            "strong float64 output(s) from "
+                            f"{', '.join(bad)} — with x64 disabled these "
+                            "silently truncate to float32 at runtime; make "
+                            "the dtype explicit or move the arithmetic to "
+                            "the host float64 path",
+                        )
+                    )
+
+    # --- AOT compile: donation aliasing + cost model ------------------------
+    try:
+        lowered = case.fn.lower(*case.args, **case.static)
+        mlir = lowered.as_text()
+        compiled = lowered.compile()
+    except Exception as exc:  # noqa: BLE001
+        report.violations.append(
+            _viol(entry, "IR0", "uncompilable-core", f"lower/compile failed: {exc!r}")
+        )
+        return report
+
+    realized = mlir.count("tf.aliasing_output")
+    if realized != case.donate_expected:
+        verb = "dropped" if realized < case.donate_expected else "extra"
+        report.violations.append(
+            _viol(
+                entry, "IR3", "dropped-donation",
+                f"declared {case.donate_expected} donated buffer(s) but the "
+                f"compiled module realizes {realized} input/output alias(es) "
+                f"— {verb} donation(s); a dropped donation allocates a fresh "
+                "carry every call (jax only warns once, at lowering)",
+            )
+        )
+
+    cost = _cost_analysis(compiled)
+    measured = {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes": float(cost.get("bytes accessed", 0.0)),
+        "prims": {k: hist[k] for k in sorted(hist)},
+    }
+    report.measured = measured
+
+    if budget is None:
+        report.violations.append(
+            _viol(
+                entry, "IR4", "missing-budget",
+                "no entry in the analysis budget — run "
+                "'python -m citizensassemblies_tpu.lint --ir --update-budget' "
+                "and commit the result",
+            )
+        )
+        return report
+
+    for metric in ("flops", "bytes"):
+        allowed = float(budget.get(metric, 0.0)) * (1.0 + tolerance)
+        if measured[metric] > allowed:
+            report.violations.append(
+                _viol(
+                    entry, "IR4", f"{metric}-budget-exceeded",
+                    f"{metric} regressed: measured {measured[metric]:.0f} > "
+                    f"budget {float(budget.get(metric, 0.0)):.0f} × "
+                    f"(1 + {tolerance:g}) — if intentional, re-ratchet with "
+                    "--update-budget",
+                )
+            )
+    budget_prims: Dict[str, int] = dict(budget.get("prims", {}))
+    for prim, count in measured["prims"].items():
+        if prim not in budget_prims:
+            report.violations.append(
+                _viol(
+                    entry, "IR4", "new-primitive",
+                    f"primitive '{prim}' ({count}×) is new to this core — "
+                    "not in its budgeted histogram; re-ratchet with "
+                    "--update-budget if intentional",
+                )
+            )
+            continue
+        allowed_n = math.ceil(budget_prims[prim] * (1.0 + tolerance))
+        if count > allowed_n:
+            report.violations.append(
+                _viol(
+                    entry, "IR4", "primitive-count-exceeded",
+                    f"primitive '{prim}' count regressed: {count} > "
+                    f"{budget_prims[prim]} × (1 + {tolerance:g})",
+                )
+            )
+    return report
+
+
+# --- budget file ------------------------------------------------------------
+
+
+def load_budget(path: Path) -> Tuple[Dict[str, Any], float]:
+    """(cores dict, tolerance) from a budget file; empty when absent."""
+    if not path.exists():
+        return {}, DEFAULT_TOLERANCE
+    data = json.loads(path.read_text(encoding="utf-8"))
+    meta = data.get("_meta", {})
+    return dict(data.get("cores", {})), float(
+        meta.get("tolerance", DEFAULT_TOLERANCE)
+    )
+
+
+def write_budget(path: Path, reports: Sequence[CoreReport], tolerance: float) -> None:
+    import jax
+
+    data = {
+        "_meta": {
+            "tolerance": tolerance,
+            "jax": jax.__version__,
+            "generated_by": (
+                "python -m citizensassemblies_tpu.lint --ir --update-budget"
+            ),
+        },
+        "cores": {
+            r.name: r.measured for r in reports if r.measured is not None
+        },
+    }
+    path.write_text(json.dumps(data, indent=1, sort_keys=True) + "\n", encoding="utf-8")
+
+
+def budget_provenance(path: Optional[Path] = None) -> Dict[str, Any]:
+    """Compact provenance of the committed budget, for bench evidence rows:
+    which ratchet state a measurement was taken against."""
+    path = path or BUDGET_PATH
+    if not path.exists():
+        return {"file": path.name, "missing": True}
+    raw = path.read_bytes()
+    data = json.loads(raw.decode("utf-8"))
+    meta = data.get("_meta", {})
+    return {
+        "file": path.name,
+        "sha256": hashlib.sha256(raw).hexdigest()[:12],
+        "cores": len(data.get("cores", {})),
+        "tolerance": meta.get("tolerance"),
+        "jax": meta.get("jax"),
+    }
+
+
+# --- the pass ---------------------------------------------------------------
+
+
+def run_ir_checks(
+    entries: Optional[Sequence[CoreEntry]] = None,
+    budget_path: Optional[Path] = None,
+    update_budget: bool = False,
+    tolerance: Optional[float] = None,
+) -> IRReport:
+    """Verify every registered core (or ``entries``) against the budget.
+
+    ``update_budget=True`` re-measures and REWRITES the budget file from the
+    current IR (the deliberate ratchet move); IR4 violations are then
+    dropped — the new budget is the measurement — while IR1–IR3 still fail.
+    """
+    budget_path = Path(budget_path) if budget_path is not None else BUDGET_PATH
+    entries = list(entries) if entries is not None else collect()
+    budgets, file_tol = load_budget(budget_path)
+    tol = float(tolerance) if tolerance is not None else file_tol
+
+    reports = [verify_core(e, budgets.get(e.name), tol) for e in entries]
+
+    if update_budget:
+        write_budget(budget_path, reports, tol)
+        for rep in reports:
+            rep.violations = [v for v in rep.violations if v.rule != "IR4"]
+    else:
+        # stale entries: a budget line for a core that no longer exists is
+        # dead ratchet state — flag it on the budget file itself
+        known = {e.name for e in entries}
+        for name in sorted(set(budgets) - known):
+            reports.append(
+                CoreReport(
+                    name=name,
+                    path=str(budget_path.name),
+                    line=1,
+                    violations=[
+                        Violation(
+                            path=str(budget_path.name), line=1, col=0,
+                            rule="IR4", name="stale-budget-entry",
+                            message=(
+                                f"[{name}] budget entry has no registered "
+                                "core — remove it via --update-budget"
+                            ),
+                        )
+                    ],
+                )
+            )
+
+    return IRReport(
+        cores=reports,
+        budget_path=str(budget_path),
+        tolerance=tol,
+        updated=update_budget,
+    )
+
+
+def budget_diff(report: IRReport) -> Dict[str, Any]:
+    """Measured-vs-budget comparison for the CI build artifact."""
+    budgets, _ = load_budget(Path(report.budget_path))
+    cores: Dict[str, Any] = {}
+    for rep in report.cores:
+        if rep.measured is None:
+            cores[rep.name] = {"status": "FAIL" if not rep.ok else "PASS"}
+            continue
+        entry: Dict[str, Any] = {
+            "status": "PASS" if rep.ok else "FAIL",
+            "measured": {
+                "flops": rep.measured["flops"],
+                "bytes": rep.measured["bytes"],
+            },
+        }
+        budget = budgets.get(rep.name)
+        if budget:
+            entry["budget"] = {
+                "flops": budget.get("flops"),
+                "bytes": budget.get("bytes"),
+            }
+            for metric in ("flops", "bytes"):
+                ref = float(budget.get(metric) or 0.0)
+                if ref > 0:
+                    entry.setdefault("ratio", {})[metric] = round(
+                        rep.measured[metric] / ref, 4
+                    )
+        cores[rep.name] = entry
+    return {
+        "budget_file": report.budget_path,
+        "tolerance": report.tolerance,
+        "provenance": budget_provenance(Path(report.budget_path)),
+        "cores": cores,
+    }
+
+
+def render_ir_report(report: IRReport) -> str:
+    """graftlint-style text: violations in file:line form, then per-core
+    PASS/FAIL lines, then the summary tail."""
+    lines = [v.render() for v in report.violations]
+    for rep in sorted(report.cores, key=lambda r: r.name):
+        status = "PASS" if rep.ok else "FAIL"
+        extra = ""
+        if rep.measured is not None:
+            extra = (
+                f" (flops={rep.measured['flops']:.0f}"
+                f" bytes={rep.measured['bytes']:.0f})"
+            )
+        lines.append(f"{rep.path}:{rep.line}: {status} [{rep.name}]{extra}")
+    n_fail = sum(1 for r in report.cores if not r.ok)
+    lines.append(
+        f"graftcheck-ir: {len(report.cores)} core(s) verified, "
+        f"{n_fail} failing, budget={report.budget_path}"
+        + (" (updated)" if report.updated else "")
+    )
+    return "\n".join(lines)
+
+
+def ir_report_as_json(report: IRReport) -> Dict[str, Any]:
+    """Stable JSON schema shared with the AST linter's ``--format json``."""
+    return {
+        "ok": report.ok,
+        "budget": report.budget_path,
+        "tolerance": report.tolerance,
+        "updated": report.updated,
+        "cores": [
+            {
+                "core": rep.name,
+                "path": rep.path,
+                "line": rep.line,
+                "status": "PASS" if rep.ok else "FAIL",
+                "measured": rep.measured,
+            }
+            for rep in sorted(report.cores, key=lambda r: r.name)
+        ],
+        "violations": [dataclasses.asdict(v) for v in report.violations],
+    }

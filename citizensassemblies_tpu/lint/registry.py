@@ -1,0 +1,124 @@
+"""graftcheck-IR core registry: the manifest of hot jitted cores.
+
+The IR verifier (``citizensassemblies_tpu.lint.ir``) can only check what it
+can *trace*, so every hot jitted core in the repo registers itself here with
+representative abstract shapes. Registration lives next to the core it
+describes: each solver module defines a small builder function decorated with
+:func:`register_ir_core`, which records (name, source file, line, builder)
+without importing jax — the builder constructs the actual
+:class:`IRCase` (the jitted callable plus ``jax.ShapeDtypeStruct`` example
+arguments) lazily, only when the IR pass runs. The :data:`MANIFEST` lists
+the modules that carry registrations, so ``collect()`` can enumerate the
+fleet deterministically; a module added to the hot path without a manifest
+entry is invisible to the verifier, which is why the manifest is part of the
+review surface (README "IR-level verification & cost budgets").
+
+Shapes are deliberately SMALL (a few hundred elements): the IR checks are
+about program *structure* — which primitives appear, which donations alias,
+how FLOPs/bytes scale per compiled program — not about runtime, so tracing
+tiny buckets on CPU keeps ``make check-ir`` inside plain CI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import inspect
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Tuple
+
+
+@dataclasses.dataclass
+class IRCase:
+    """A traceable description of one jitted core.
+
+    ``fn`` must be the jitted callable itself (it needs ``.lower``);
+    ``args`` are example operands — normally ``jax.ShapeDtypeStruct``s or
+    pytrees of them — and ``static`` the static keyword arguments.
+    ``donate_expected`` is how many input→output buffer aliases the
+    compiled executable must realize (normally ``len(donate_argnums)``);
+    the donation check fails when the lowered module shows fewer, i.e. a
+    declared donation was silently dropped. ``allow_f64`` tags the cert
+    cores whose arithmetic is float64 *on purpose* — there the dtype check
+    inverts and flags f64→f32 ``convert_element_type`` narrowing instead.
+    ``x64_trace=False`` skips the enable-x64 dtype trace for kernels whose
+    tracing is dtype-pinned some other way.
+    """
+
+    fn: Any
+    args: Tuple[Any, ...]
+    static: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    donate_expected: int = 0
+    allow_f64: bool = False
+    x64_trace: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class CoreEntry:
+    """One registered core: identity, provenance, and the lazy builder."""
+
+    name: str
+    path: str  # repo-relative source file of the registration (reports)
+    line: int  # line of the builder (file:line in PASS/FAIL output)
+    build: Callable[[], IRCase]
+
+
+#: name -> entry, populated by importing the MANIFEST modules
+_REGISTRY: Dict[str, CoreEntry] = {}
+
+#: every module that registers at least one core. ``collect()`` imports
+#: these; keep the list sorted by package path so reports are deterministic.
+MANIFEST: Tuple[str, ...] = (
+    "citizensassemblies_tpu.kernels.sampler",
+    "citizensassemblies_tpu.models.legacy",
+    "citizensassemblies_tpu.parallel.solver",
+    "citizensassemblies_tpu.parallel.sweep",
+    "citizensassemblies_tpu.solvers.batch_lp",
+    "citizensassemblies_tpu.solvers.face_decompose",
+    "citizensassemblies_tpu.solvers.lp_pdhg",
+    "citizensassemblies_tpu.solvers.qp",
+)
+
+
+def _rel_path(file: str) -> str:
+    """Source path relative to the repo root (the package's parent)."""
+    p = Path(file).resolve()
+    pkg_root = Path(__file__).resolve().parent.parent.parent
+    try:
+        return str(p.relative_to(pkg_root))
+    except ValueError:
+        return str(p)
+
+
+def register_ir_core(name: str) -> Callable:
+    """Decorator: register ``build`` as the lazy IRCase builder for ``name``.
+
+    The decorated function takes no arguments and returns an :class:`IRCase`;
+    it may import jax freely (it only runs when the IR pass does). The
+    registration's ``file:line`` is what the verifier reports for this core.
+    """
+
+    def deco(build: Callable[[], IRCase]) -> Callable[[], IRCase]:
+        src = inspect.getsourcefile(build) or "<unknown>"
+        _REGISTRY[name] = CoreEntry(
+            name=name,
+            path=_rel_path(src),
+            line=build.__code__.co_firstlineno,
+            build=build,
+        )
+        return build
+
+    return deco
+
+
+def collect() -> List[CoreEntry]:
+    """Import every MANIFEST module and return the registered cores, sorted.
+
+    Import errors propagate: a hot module that no longer imports is itself a
+    CI-worthy failure, not something to skip silently.
+    """
+    for mod in MANIFEST:
+        importlib.import_module(mod)
+    return [
+        _REGISTRY[name] for name in sorted(_REGISTRY)
+    ]

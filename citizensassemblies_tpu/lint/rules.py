@@ -583,6 +583,240 @@ class DtypeDisciplineRule:
 # --- R5: tracer branching & static-arg hygiene ------------------------------
 
 
+class ThreadDisciplineRule:
+    """R7 — shared state written from a worker thread without a lock.
+
+    The host-overlap pipeline (``solvers/face_decompose._AnchorPricer``
+    double-buffering MILPs against the device master, and the chunked native
+    slice streams in ``solvers/native_oracle``) is the repo's only threaded
+    code, and its discipline is: a worker runs *pure* functions over
+    pre-partitioned buffers; all cross-thread handoff goes through the
+    ``Future``/``Queue`` machinery, and any shared mutable state takes a
+    ``Lock``. The rule enforces exactly that, scoped to modules that import
+    ``threading``/``concurrent.futures``: find the worker roots (first
+    argument of ``<executor>.submit(...)``/``<executor>.map(...)`` for names
+    bound to a ``ThreadPoolExecutor``, plus ``Thread(target=...)``), take the
+    transitive same-module closure (bare-name and ``self.method`` calls), and
+    flag writes to module-level state (``global`` rebinding, stores into a
+    module-level dict/attribute) or instance state (``self.attr = ...``)
+    that are not under a ``with <…lock…>:`` block.
+    """
+
+    rule_id = "R7"
+    name = "thread-discipline"
+    description = "unlocked shared-state write reachable from a worker thread"
+
+    _THREAD_MODULES = ("threading", "concurrent.futures", "concurrent")
+
+    @staticmethod
+    def _imports_threading(tree: ast.Module) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                if any(
+                    a.name.split(".")[0] in ("threading", "concurrent")
+                    for a in node.names
+                ):
+                    return True
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.split(".")[0] in ("threading", "concurrent"):
+                    return True
+        return False
+
+    @staticmethod
+    def _executor_names(tree: ast.Module) -> Set[str]:
+        """Bare names and attribute names bound to a ThreadPoolExecutor
+        construction: ``pool = ThreadPoolExecutor(...)``, ``with
+        ThreadPoolExecutor(...) as pool:``, ``self._pool = (ThreadPool…)``.
+        Conditional expressions (``X if overlap else None``) are unwrapped.
+        """
+
+        def is_executor_call(node: ast.AST) -> bool:
+            if isinstance(node, ast.IfExp):
+                return is_executor_call(node.body) or is_executor_call(node.orelse)
+            if not isinstance(node, ast.Call):
+                return False
+            d = dotted(node.func)
+            return d is not None and d.rsplit(".", 1)[-1] == "ThreadPoolExecutor"
+
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and is_executor_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        names.add(t.attr)
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if (
+                        is_executor_call(item.context_expr)
+                        and isinstance(item.optional_vars, ast.Name)
+                    ):
+                        names.add(item.optional_vars.id)
+        return names
+
+    @staticmethod
+    def _function_table(tree: ast.Module) -> Dict[str, List[ast.FunctionDef]]:
+        """Every FunctionDef (module-level, nested, methods) keyed by name."""
+        table: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                table.setdefault(node.name, []).append(node)
+        return table
+
+    def _worker_roots(self, tree: ast.Module) -> List[ast.AST]:
+        """Function/lambda nodes handed to a worker thread."""
+        executors = self._executor_names(tree)
+        table = self._function_table(tree)
+        roots: List[ast.AST] = []
+
+        def resolve(ref: ast.AST) -> None:
+            if isinstance(ref, ast.Lambda):
+                roots.append(ref)
+            elif isinstance(ref, ast.Name):
+                roots.extend(table.get(ref.id, []))
+            elif (
+                isinstance(ref, ast.Attribute)
+                and isinstance(ref.value, ast.Name)
+                and ref.value.id == "self"
+            ):
+                roots.extend(table.get(ref.attr, []))
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in ("submit", "map"):
+                recv = func.value
+                recv_name = (
+                    recv.id if isinstance(recv, ast.Name)
+                    else recv.attr if isinstance(recv, ast.Attribute)
+                    else None
+                )
+                if recv_name in executors and node.args:
+                    resolve(node.args[0])
+            d = dotted(func)
+            if d is not None and d.rsplit(".", 1)[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        resolve(kw.value)
+        return roots
+
+    @staticmethod
+    def _under_lock(node: ast.AST, parents) -> bool:
+        """Is this statement inside a ``with`` whose context mentions a
+        lock? Matched by name (…lock…, case-insensitive) or a direct
+        ``Lock()``/``RLock()`` construction — the explicit escape for
+        anything subtler is ``# graftlint: disable=R7 -- reason``."""
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    expr = item.context_expr
+                    d = dotted(expr) or ""
+                    if isinstance(expr, ast.Call):
+                        d = dotted(expr.func) or ""
+                    last = d.rsplit(".", 1)[-1].lower()
+                    if "lock" in last:
+                        return True
+            cur = parents.get(cur)
+        return False
+
+    def check_module(self, mod: ModuleSource) -> List[Violation]:
+        tree = mod.tree
+        if not self._imports_threading(tree):
+            return []
+        roots = self._worker_roots(tree)
+        if not roots:
+            return []
+        parents = parent_map(tree)
+        table = self._function_table(tree)
+        module_names: Set[str] = {
+            t.id
+            for node in tree.body
+            if isinstance(node, ast.Assign)
+            for t in node.targets
+            if isinstance(t, ast.Name)
+        } | {
+            node.target.id
+            for node in tree.body
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name)
+        }
+
+        # transitive closure over same-module calls (bare name, self.method)
+        reachable: List[ast.AST] = []
+        seen: Set[ast.AST] = set()
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            reachable.append(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                targets: List[ast.FunctionDef] = []
+                if isinstance(node.func, ast.Name):
+                    targets = table.get(node.func.id, [])
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                ):
+                    targets = table.get(node.func.attr, [])
+                work.extend(t for t in targets if t not in seen)
+
+        out: List[Violation] = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            out.append(
+                Violation(
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    rule=self.rule_id, name=self.name,
+                    message=(
+                        f"{what} written from worker-thread code without a "
+                        "Lock/Queue mediating it — the overlap pipeline's "
+                        "workers must stay pure over pre-partitioned buffers"
+                    ),
+                )
+            )
+
+        flagged: Set[Tuple[int, int]] = set()
+        for fn in reachable:
+            globals_here: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    globals_here.update(node.names)
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in flagged or self._under_lock(node, parents):
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in globals_here:
+                        flagged.add(key)
+                        flag(node, f"module global '{t.id}'")
+                    elif isinstance(t, ast.Attribute):
+                        base = t.value
+                        if isinstance(base, ast.Name) and base.id == "self":
+                            flagged.add(key)
+                            flag(node, f"instance state 'self.{t.attr}'")
+                        elif isinstance(base, ast.Name) and base.id in module_names:
+                            flagged.add(key)
+                            flag(node, f"module state '{base.id}.{t.attr}'")
+                    elif isinstance(t, ast.Subscript):
+                        base = t.value
+                        if isinstance(base, ast.Name) and base.id in module_names:
+                            flagged.add(key)
+                            flag(node, f"module container '{base.id}[...]'")
+        return out
+
+
 class TracerBranchRule:
     """R5 — Python ``if``/``while`` on tracer values, and unhashable values
     passed for static arguments.

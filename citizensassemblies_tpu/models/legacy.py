@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.core.instance import DenseInstance, SelectionError
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.ops.pairs import pair_matrix_from_panels
 from citizensassemblies_tpu.utils.config import Config, default_config
 
@@ -175,6 +176,25 @@ def _sample_panels_kernel(
     # final lower-quota audit (check_min_cats, legacy.py:160-168)
     failed = failed | jnp.any(selected < qmin[None, :], axis=1)
     return panels, ~failed
+
+
+@register_ir_core("legacy.scan_sampler")
+def _ir_scan_sampler() -> IRCase:
+    """The scan-path batch draw at a small (n=40, F=12, k=6, B=32) shape —
+    the per-step matmuls and the per-chain fold_in key stream are the
+    verified structure (lint/ir.py)."""
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    n, F, k, B = 40, 12, 6, 32
+    dense = DenseInstance(
+        A=S((n, F), jnp.bool_), qmin=S((F,), i32), qmax=S((F,), i32),
+        cat_of_feature=S((F,), i32), k=k, n_categories=3,
+    )
+    return IRCase(
+        fn=_sample_panels_kernel,
+        args=(dense, S((2,), jnp.uint32)),
+        static=dict(B=B),
+    )
 
 
 def sample_panels_batch(

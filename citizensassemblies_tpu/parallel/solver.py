@@ -43,6 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.parallel.mesh import shard_map_compat
 from citizensassemblies_tpu.solvers.highs_backend import DualSolution
 from citizensassemblies_tpu.utils.config import Config, default_config
@@ -173,6 +174,42 @@ def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
 _CORE_CACHE: dict = {}
 
 
+def _get_sharded_jit(mesh: Mesh, block_iters: int, max_blocks: int):
+    """The COMPILED-program cache for the sharded PDHG core, keyed per
+    (mesh, block schedule) — shared by the production marshalling below and
+    the IR verifier's registration, so both see the same jitted object."""
+    axes = mesh.axis_names
+    key = (mesh, axes, block_iters, max_blocks)
+    core = _CORE_CACHE.get(key)
+    if core is None:
+        core = jax.jit(
+            _sharded_core(mesh, axes, block_iters, max_blocks),
+            donate_argnums=(1,),
+        )
+        _CORE_CACHE[key] = core
+    return core
+
+
+@register_ir_core("parallel.sharded_dual_lp")
+def _ir_sharded_dual_lp() -> IRCase:
+    """The mesh-sharded dual-LP solve on a deterministic ONE-device mesh:
+    per-shard shapes must not depend on how many devices the verifying host
+    happens to expose, or the committed cost budget would be
+    environment-dependent."""
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("ir_rows",))
+    rows, nv = 64, 33
+    return IRCase(
+        fn=_get_sharded_jit(mesh, block_iters=128, max_blocks=8),
+        args=(
+            S((rows, nv), f32), S((rows,), f32), S((nv,), f32),
+            S((nv,), f32), S((1,), f32), S((1,), f32),
+        ),
+        donate_expected=1,  # h (shape/sharding-matched with the λ shard)
+    )
+
+
 def _run_core(
     mesh: Mesh,
     G: np.ndarray,
@@ -196,14 +233,7 @@ def _run_core(
     donated (it is shape/sharding-matched with the returned λ shard), freeing
     its buffer for the output instead of allocating a fresh one per round."""
     axes = mesh.axis_names
-    key = (mesh, axes, block_iters, max_blocks)
-    core = _CORE_CACHE.get(key)
-    if core is None:
-        core = jax.jit(
-            _sharded_core(mesh, axes, block_iters, max_blocks),
-            donate_argnums=(1,),
-        )
-        _CORE_CACHE[key] = core
+    core = _get_sharded_jit(mesh, block_iters, max_blocks)
     row_sharding = NamedSharding(mesh, P(axes, None))
     vec_sharding = NamedSharding(mesh, P(axes))
     rep_sharding = NamedSharding(mesh, P())

@@ -23,6 +23,60 @@ import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.core.instance import DenseInstance
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+
+#: memoized jitted sweep core — one traced program per (k, padded shape)
+#: via the jit cache, instead of re-tracing the vmap on every sweep call
+_SWEEP_ALLOC_CORE = None
+
+
+def _get_sweep_alloc_core():
+    """Build (once) the jitted vmap-over-instances MC allocation program.
+
+    Per instance: draw ``B`` chains with the scan sampler, reduce accepted
+    panels to per-agent selection frequencies and the acceptance rate. The
+    vmap adds the instance axis; ``B`` stays static so the inner scan
+    kernel's chain count is a compile-time constant.
+    """
+    global _SWEEP_ALLOC_CORE
+    if _SWEEP_ALLOC_CORE is None:
+        from functools import partial
+
+        from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+
+        def one(dense_i: DenseInstance, key_i, B: int):
+            panels, ok = _sample_panels_kernel(dense_i, key_i, B)
+            n_max = dense_i.A.shape[0]
+            onehot = jax.nn.one_hot(panels, n_max, dtype=jnp.float32)  # [B, k, n]
+            counts = jnp.einsum("bkn,b->n", onehot, ok.astype(jnp.float32))
+            denom = jnp.maximum(ok.sum(), 1)
+            return counts / denom, ok.mean()
+
+        vmapped = jax.vmap(one, in_axes=(0, 0, None))
+
+        def alloc(batched: DenseInstance, keys, *, B: int):
+            return vmapped(batched, keys, B)
+
+        _SWEEP_ALLOC_CORE = partial(jax.jit, static_argnames=("B",))(alloc)
+    return _SWEEP_ALLOC_CORE
+
+
+@register_ir_core("sweep.alloc_core")
+def _ir_sweep_alloc_core() -> IRCase:
+    """A two-instance padded sweep at the scan sampler's small shape — the
+    whole estimator fleet as one device program (lint/ir.py)."""
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    I, n, F, k, B = 2, 40, 12, 6, 32
+    batched = DenseInstance(
+        A=S((I, n, F), jnp.bool_), qmin=S((I, F), i32), qmax=S((I, F), i32),
+        cat_of_feature=S((I, F), i32), k=k, n_categories=3,
+    )
+    return IRCase(
+        fn=_get_sweep_alloc_core(),
+        args=(batched, S((I, 2), jnp.uint32)),
+        static=dict(B=B),
+    )
 
 
 def pad_and_stack(denses: Sequence[DenseInstance]) -> Tuple[DenseInstance, np.ndarray]:
@@ -69,24 +123,15 @@ def sweep_legacy_allocations(
     per-agent selection frequencies over the accepted chains of each
     instance (padding agents report 0).
     """
-    from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
-
     batched, n_real = pad_and_stack(denses)
     if key is None:
         key = jax.random.PRNGKey(seed)
     keys = jax.random.split(key, len(denses))
 
-    def one(dense_i: DenseInstance, key_i):
-        panels, ok = _sample_panels_kernel(dense_i, key_i, chains_per_instance)
-        n_max = dense_i.A.shape[0]
-        onehot = jax.nn.one_hot(panels, n_max, dtype=jnp.float32)  # [B, k, n]
-        counts = jnp.einsum("bkn,b->n", onehot, ok.astype(jnp.float32))
-        denom = jnp.maximum(ok.sum(), 1)
-        return counts / denom, ok.mean()
-
-    # batch every array leaf; static fields (k, n_categories) ride along as aux
-    axes = jax.tree_util.tree_map(lambda _: 0, batched)
-    alloc, rate = jax.vmap(one, in_axes=(axes, 0))(batched, keys)
+    # one jitted program per (k, padded shape): the memoized core batches
+    # every array leaf; static fields (k, n_categories) ride along as aux
+    core = _get_sweep_alloc_core()
+    alloc, rate = core(batched, keys, B=int(chains_per_instance))
     return np.asarray(alloc, dtype=np.float64), np.asarray(rate, dtype=np.float64)
 
 

@@ -55,6 +55,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
 
@@ -153,6 +154,28 @@ def _get_batch_core(max_iters: int, check_every: int):
         core = jax.jit(jax.vmap(one), donate_argnums=(5, 6, 7))
         _BATCH_CORES[key] = core
     return core
+
+
+@register_ir_core("batch_lp.vmapped_core")
+def _ir_batch_core() -> IRCase:
+    """One small (m1=64, m2=1, nv=65) bucket with a 4-lane batch — the
+    vmapped while_loop carries the per-lane convergence masks, which is the
+    structure the IR pass must keep seeing (lint/ir.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    B, nv, m1, m2 = 4, 65, 64, 1
+    return IRCase(
+        fn=_get_batch_core(1024, 128),
+        args=(
+            S((B, nv), f32), S((B, m1, nv), f32), S((B, m1), f32),
+            S((B, m2, nv), f32), S((B, m2), f32),
+            S((B, nv), f32), S((B, m1), f32), S((B, m2), f32), S((B,), f32),
+        ),
+        donate_expected=3,  # the stacked x0/lam0/mu0 carries
+    )
 
 
 def _bucket_key(insts: Sequence[BatchLP], cap: int) -> Tuple[int, int, int]:

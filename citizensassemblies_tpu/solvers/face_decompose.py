@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
 from citizensassemblies_tpu.utils.logging import RunLog
@@ -145,6 +146,31 @@ def _get_move_screen_core():
 
         _MOVE_SCREEN_CORE = core
     return _MOVE_SCREEN_CORE
+
+
+@register_ir_core("face_decompose.move_screen")
+def _ir_move_screen() -> IRCase:
+    """The batched move screen at one small (T=32, F=40, one leftover
+    category) shape — the uint32 bitmask lanes and the fixed-size nonzero
+    decode are the structure under verification (lint/ir.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    i32, u32 = jnp.int32, jnp.uint32
+    T, F, Pp, L = 32, 40, 4096, 1
+    return IRCase(
+        fn=_get_move_screen_core(),
+        args=(
+            S((_SCREEN_ROWS, T), i32), S((_SCREEN_ROWS, 64), i32),
+            S((64,), i32), S((64,), i32), S((_SCREEN_ROWS, F), i32),
+            S((F,), i32), S((F,), i32), S((T,), i32),
+            S((Pp,), i32), S((Pp,), i32), S((Pp,), jnp.bool_),
+            S((Pp,), u32), S((Pp,), u32), S((Pp,), u32), S((Pp,), u32),
+            S((L, Pp), i32), S((L, Pp), i32), S((L,), jnp.bool_),
+        ),
+        static=dict(cap=4096),
+    )
 
 
 #: compositions per screening batch: ``realize_profile`` expands at most the

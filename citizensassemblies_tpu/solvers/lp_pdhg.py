@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import no_implicit_transfers
 
@@ -549,6 +550,45 @@ def solve_two_sided_master(
 
 
 # --- the two LP shapes of the LEXIMIN machinery -----------------------------
+
+
+# --- graftcheck-IR registrations (lint/ir.py) -------------------------------
+# Representative shapes are one small dual-LP bucket (Cp=64 rows) and one
+# small two-sided master bucket — structure, not scale, is what the IR
+# verifier checks, so tiny buckets keep `make check-ir` CPU-cheap.
+
+
+@register_ir_core("lp_pdhg.pdhg_core")
+def _ir_pdhg_core() -> IRCase:
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    nv, m1, m2 = 65, 64, 1
+    return IRCase(
+        fn=_pdhg_core,
+        args=(
+            S((nv,), f32), S((m1, nv), f32), S((m1,), f32),
+            S((m2, nv), f32), S((m2,), f32),
+            S((nv,), f32), S((m1,), f32), S((m2,), f32), S((), f32),
+        ),
+        static=dict(max_iters=1024, check_every=128),
+        donate_expected=3,  # x0, lam0, mu0
+    )
+
+
+@register_ir_core("lp_pdhg.two_sided_core")
+def _ir_two_sided_core() -> IRCase:
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    T, C = 24, 128
+    return IRCase(
+        fn=_pdhg_two_sided_core,
+        args=(
+            S((T, C), f32), S((T,), f32), S((C,), f32),
+            S((C + 1,), f32), S((2 * T,), f32), S((), f32), S((), f32),
+        ),
+        static=dict(max_iters=1024, check_every=128),
+        donate_expected=2,  # x0, lam0 (mu0 is a scalar, undonated by design)
+    )
 
 
 def solve_dual_lp_pdhg(

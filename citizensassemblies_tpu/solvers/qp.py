@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+
 
 @jax.jit
 def project_simplex(v: jnp.ndarray) -> jnp.ndarray:
@@ -171,6 +173,36 @@ def _get_l2_fused_core(
 
     _L2_FUSED_CORES[key] = fused
     return fused
+
+
+# --- graftcheck-IR registrations (lint/ir.py) -------------------------------
+
+
+@register_ir_core("qp.l2_dual_ascent")
+def _ir_dual_ascent() -> IRCase:
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    C, n = 96, 24
+    return IRCase(
+        fn=_min_norm_dual_ascent,
+        args=(S((C, n), f32), S((n,), f32), S((), f32), S((), f32), S((2 * n,), f32)),
+        static=dict(iters=2048),
+        donate_expected=1,  # lam0
+    )
+
+
+@register_ir_core("qp.l2_fused_core")
+def _ir_l2_fused() -> IRCase:
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    C, n = 96, 24
+    return IRCase(
+        fn=_get_l2_fused_core(1024, 128, 256, 8),
+        args=(
+            S((C, n), f32), S((n,), f32), S((C,), f32),
+            S((), f32), S((), f32), S((), f32),
+        ),
+    )
 
 
 def _min_eps_pdhg(P: np.ndarray, PT: np.ndarray, target: np.ndarray, cfg=None):

@@ -1,0 +1,245 @@
+"""graftcheck-IR self-tests (lint/ir.py + lint/registry.py).
+
+Three layers, mirroring test_lint.py's contract:
+
+* fixture cores that deliberately embed each regression class — a host
+  callback, a strong-f64 op, a silently-dropped donation — each FAIL with
+  the right IR rule;
+* the budget ratchet: inflating a stored entry passes, shrinking it below
+  the measured cost fails, ``--update-budget`` round-trips to a clean pass;
+* the real package: every registered core (the acceptance floor is 8)
+  verifies PASS against the committed ``ANALYSIS_BUDGET.json`` — which is
+  what makes an injected callback/f64/donation/cost regression in a hot
+  core a tier-1 failure, not an offline-bench discovery.
+"""
+
+import json
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from citizensassemblies_tpu.lint.ir import (
+    BUDGET_PATH,
+    budget_diff,
+    ir_report_as_json,
+    render_ir_report,
+    run_ir_checks,
+)
+from citizensassemblies_tpu.lint.registry import CoreEntry, IRCase, collect
+
+S = jax.ShapeDtypeStruct
+F32 = jnp.float32
+
+
+def _entry(name: str, build) -> CoreEntry:
+    return CoreEntry(name=name, path=f"tests/fixtures/{name}.py", line=1, build=build)
+
+
+def _rules(report):
+    return {v.rule for v in report.violations}
+
+
+# --- fixture regression classes ---------------------------------------------
+
+
+@jax.jit
+def _cb_core(x):
+    jax.debug.print("x sum = {s}", s=x.sum())
+    return x * 2.0
+
+
+def _callback_case() -> IRCase:
+    return IRCase(fn=_cb_core, args=(S((8,), F32),))
+
+
+@jax.jit
+def _f64_core(x):
+    # graftlint: disable=R4 -- deliberate IR2 fixture: the f64 leak under test
+    return x.astype(jnp.float64).sum()
+
+
+def _f64_case() -> IRCase:
+    return IRCase(fn=_f64_core, args=(S((8,), F32),))
+
+
+# donated arg shape matches NO output shape -> XLA drops the donation
+_dropped_donation_core = partial(jax.jit, donate_argnums=(0,))(
+    lambda x: x.sum()
+)
+
+
+def _dropped_donation_case() -> IRCase:
+    return IRCase(
+        fn=_dropped_donation_core, args=(S((16,), F32),), donate_expected=1
+    )
+
+
+@jax.jit
+def _clean_core(G, x):
+    return jnp.maximum(G @ x, 0.0)
+
+
+def _clean_case() -> IRCase:
+    return IRCase(fn=_clean_core, args=(S((16, 8), F32), S((8,), F32)))
+
+
+def test_callback_in_core_fails(tmp_path):
+    report = run_ir_checks(
+        entries=[_entry("fixture.callback", _callback_case)],
+        budget_path=tmp_path / "b.json",
+        update_budget=True,  # isolate IR1 from the missing-budget failure
+    )
+    assert "IR1" in _rules(report), render_ir_report(report)
+    assert any("debug_callback" in v.message for v in report.violations)
+
+
+@pytest.mark.filterwarnings("ignore:Explicitly requested dtype")
+def test_f64_op_in_core_fails(tmp_path):
+    report = run_ir_checks(
+        entries=[_entry("fixture.f64", _f64_case)],
+        budget_path=tmp_path / "b.json",
+        update_budget=True,
+    )
+    assert "IR2" in _rules(report), render_ir_report(report)
+
+
+@pytest.mark.filterwarnings("ignore:Some donated buffers were not usable")
+def test_dropped_donation_fails(tmp_path):
+    report = run_ir_checks(
+        entries=[_entry("fixture.dropped_donation", _dropped_donation_case)],
+        budget_path=tmp_path / "b.json",
+        update_budget=True,
+    )
+    assert "IR3" in _rules(report), render_ir_report(report)
+    assert any("dropped" in v.message for v in report.violations)
+
+
+def test_clean_fixture_passes(tmp_path):
+    report = run_ir_checks(
+        entries=[_entry("fixture.clean", _clean_case)],
+        budget_path=tmp_path / "b.json",
+        update_budget=True,
+    )
+    assert report.ok, render_ir_report(report)
+
+
+# --- the budget ratchet ------------------------------------------------------
+
+
+def _write_then_load(tmp_path):
+    """Measure the clean fixture into a fresh budget; return its path."""
+    budget = tmp_path / "budget.json"
+    report = run_ir_checks(
+        entries=[_entry("fixture.clean", _clean_case)],
+        budget_path=budget,
+        update_budget=True,
+    )
+    assert report.ok and budget.exists()
+    return budget
+
+
+def test_update_budget_round_trips(tmp_path):
+    budget = _write_then_load(tmp_path)
+    report = run_ir_checks(
+        entries=[_entry("fixture.clean", _clean_case)], budget_path=budget
+    )
+    assert report.ok, render_ir_report(report)
+    data = json.loads(budget.read_text())
+    entry = data["cores"]["fixture.clean"]
+    assert entry["flops"] > 0 and entry["bytes"] > 0 and entry["prims"]
+
+
+def test_inflated_budget_still_passes(tmp_path):
+    budget = _write_then_load(tmp_path)
+    data = json.loads(budget.read_text())
+    data["cores"]["fixture.clean"]["flops"] *= 10
+    data["cores"]["fixture.clean"]["bytes"] *= 10
+    budget.write_text(json.dumps(data))
+    report = run_ir_checks(
+        entries=[_entry("fixture.clean", _clean_case)], budget_path=budget
+    )
+    assert report.ok, render_ir_report(report)
+
+
+def test_shrunk_budget_fails(tmp_path):
+    budget = _write_then_load(tmp_path)
+    data = json.loads(budget.read_text())
+    data["cores"]["fixture.clean"]["flops"] /= 10
+    budget.write_text(json.dumps(data))
+    report = run_ir_checks(
+        entries=[_entry("fixture.clean", _clean_case)], budget_path=budget
+    )
+    assert "IR4" in _rules(report), render_ir_report(report)
+    assert any("flops regressed" in v.message for v in report.violations)
+
+
+def test_new_primitive_fails(tmp_path):
+    budget = _write_then_load(tmp_path)
+    data = json.loads(budget.read_text())
+    prims = data["cores"]["fixture.clean"]["prims"]
+    prims.pop("dot_general", None) or prims.pop("pjit", None)
+    budget.write_text(json.dumps(data))
+    report = run_ir_checks(
+        entries=[_entry("fixture.clean", _clean_case)], budget_path=budget
+    )
+    viols = [v for v in report.violations if v.name == "new-primitive"]
+    assert viols, render_ir_report(report)
+
+
+def test_missing_and_stale_budget_entries_fail(tmp_path):
+    budget = _write_then_load(tmp_path)
+    data = json.loads(budget.read_text())
+    data["cores"]["fixture.retired"] = data["cores"].pop("fixture.clean")
+    budget.write_text(json.dumps(data))
+    report = run_ir_checks(
+        entries=[_entry("fixture.clean", _clean_case)], budget_path=budget
+    )
+    names = {v.name for v in report.violations}
+    assert "missing-budget" in names, render_ir_report(report)
+    assert "stale-budget-entry" in names, render_ir_report(report)
+
+
+def test_budget_diff_schema(tmp_path):
+    budget = _write_then_load(tmp_path)
+    report = run_ir_checks(
+        entries=[_entry("fixture.clean", _clean_case)], budget_path=budget
+    )
+    diff = budget_diff(report)
+    core = diff["cores"]["fixture.clean"]
+    assert core["status"] == "PASS"
+    assert core["ratio"]["flops"] == pytest.approx(1.0)
+    as_json = ir_report_as_json(report)
+    assert as_json["ok"] and as_json["cores"][0]["status"] == "PASS"
+
+
+# --- the real package --------------------------------------------------------
+
+
+def test_registry_enumerates_the_hot_cores():
+    entries = collect()
+    names = [e.name for e in entries]
+    assert len(names) == len(set(names))
+    # the acceptance floor: the IR pass traces at least 8 registered cores
+    assert len(names) >= 8, names
+    for expected in (
+        "lp_pdhg.pdhg_core", "lp_pdhg.two_sided_core", "batch_lp.vmapped_core",
+        "qp.l2_fused_core", "face_decompose.move_screen",
+        "kernels.pallas_sampler", "legacy.scan_sampler",
+        "parallel.sharded_dual_lp", "sweep.alloc_core",
+    ):
+        assert expected in names
+
+
+def test_every_registered_core_passes_against_committed_budget():
+    """The CI contract: `make check-ir` exits 0 on the real package. Running
+    the identical pass inside tier-1 makes an injected callback, f64 leak,
+    dropped donation or cost regression in ANY hot core a test failure."""
+    assert BUDGET_PATH.exists(), (
+        "ANALYSIS_BUDGET.json is not committed — run "
+        "'python -m citizensassemblies_tpu.lint --ir --update-budget'"
+    )
+    report = run_ir_checks()
+    assert len(report.cores) >= 8
+    assert report.ok, render_ir_report(report)

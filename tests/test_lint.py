@@ -1,9 +1,11 @@
-"""graftlint self-tests: one known-violating fixture per rule R1–R6, the
-suppression syntax (including the reason requirement, R0), and the clean
-pass over the real package — which is what makes a NEW violation fail
-tier-1, per the CI contract in README "Static analysis & guard rails".
+"""graftlint self-tests: one known-violating fixture per rule R1–R7, the
+suppression syntax (the reason requirement and the unused-suppression check,
+both R0), the JSON output schema, and the clean pass over the real package
+plus bench.py and tests/ — which is what makes a NEW violation fail tier-1,
+per the CI contract in README "Static analysis & guard rails".
 """
 
+import json
 from pathlib import Path
 
 from citizensassemblies_tpu.lint import lint_paths, render_report
@@ -238,6 +240,84 @@ def test_r6_dead_and_undocumented_knobs(tmp_path):
     assert all("dead_knob" in v.message for v in viols)
 
 
+# --- R7: thread discipline --------------------------------------------------
+
+
+def test_r7_unlocked_worker_write(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "\n"
+        "_RESULTS = {}\n"
+        "\n"
+        "def worker(i):\n"
+        "    _RESULTS[i] = i * 2\n"
+        "\n"
+        "def run(items):\n"
+        "    with ThreadPoolExecutor(max_workers=2) as pool:\n"
+        "        list(pool.map(worker, items))\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R7"]
+    assert viols, render_report(report)
+    assert "_RESULTS" in viols[0].message
+
+
+def test_r7_lock_mediated_write_allowed(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "\n"
+        "_RESULTS = {}\n"
+        "_lock = threading.Lock()\n"
+        "\n"
+        "def worker(i):\n"
+        "    with _lock:\n"
+        "        _RESULTS[i] = i * 2\n"
+        "\n"
+        "def run(items):\n"
+        "    with ThreadPoolExecutor(max_workers=2) as pool:\n"
+        "        list(pool.map(worker, items))\n"
+    )})
+    assert "R7" not in _rules(report), render_report(report)
+
+
+def test_r7_instance_state_from_submit(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "\n"
+        "class Pipeline:\n"
+        "    def __init__(self):\n"
+        "        self.pool = ThreadPoolExecutor(max_workers=1)\n"
+        "\n"
+        "    def _work(self, x):\n"
+        "        self.result = x + 1\n"
+        "\n"
+        "    def go(self, x):\n"
+        "        return self.pool.submit(self._work, x)\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R7"]
+    assert viols, render_report(report)
+    assert "self.result" in viols[0].message
+
+
+def test_r7_caller_thread_writes_not_flagged(tmp_path):
+    # writes on the SUBMITTING side (the caller thread owns them) are fine
+    report = _lint(tmp_path, {"mod.py": (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "\n"
+        "class Pipeline:\n"
+        "    def __init__(self):\n"
+        "        self.pool = ThreadPoolExecutor(max_workers=1)\n"
+        "        self.pending = None\n"
+        "\n"
+        "    def _work(self, x):\n"
+        "        return x + 1\n"
+        "\n"
+        "    def go(self, x):\n"
+        "        self.pending = self.pool.submit(self._work, x)\n"
+    )})
+    assert "R7" not in _rules(report), render_report(report)
+
+
 # --- suppression syntax -----------------------------------------------------
 
 
@@ -281,16 +361,82 @@ def test_file_wide_suppression(tmp_path):
     assert report.suppressed == 2
 
 
+def test_unused_suppression_is_flagged(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def residual(x):\n"
+        "    # graftlint: disable=R4 -- once suppressed a downcast, long gone\n"
+        "    return jnp.asarray(x, dtype=jnp.float32)\n"
+    )})
+    viols = [v for v in report.violations if v.name == "unused-suppression"]
+    assert viols, render_report(report)
+    assert "R4" in viols[0].message
+
+
+def test_partially_used_directive_flags_only_the_stale_rule(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def residual(x):\n"
+        "    # graftlint: disable=R4,R1 -- R4 real, R1 stale\n"
+        "    return jnp.asarray(x, dtype=jnp.float64)\n"
+    )})
+    viols = [v for v in report.violations if v.name == "unused-suppression"]
+    assert len(viols) == 1, render_report(report)
+    assert "R1" in viols[0].message and report.suppressed == 1
+
+
+def test_directive_inside_string_literal_is_inert(tmp_path):
+    # directives are COMMENT tokens: one spelled inside a string (a fixture,
+    # a docstring example) neither suppresses nor counts as unused
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax.numpy as jnp\n"
+        "\n"
+        'FIXTURE = "# graftlint: disable=R4"\n'
+        "\n"
+        "def residual(x):\n"
+        "    return jnp.asarray(x, dtype=jnp.float64)\n"
+    )})
+    assert "R4" in _rules(report), render_report(report)
+    assert not any(v.rule == "R0" for v in report.violations)
+
+
+# --- JSON output -------------------------------------------------------------
+
+
+def test_json_format_schema(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "def run(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(lambda y: y)(x)\n",
+        encoding="utf-8",
+    )
+    rc = lint_main([str(bad), "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1 and data["ok"] is False
+    v = data["violations"][0]
+    assert {"rule", "name", "path", "line", "col", "message"} <= set(v)
+    assert v["rule"] == "R2"
+
+
 # --- the real package must be clean (tier-1 integration) --------------------
 
 
 def test_real_package_is_lint_clean():
     """The acceptance contract: ``python -m citizensassemblies_tpu.lint
-    citizensassemblies_tpu/`` exits 0 — every pre-existing violation fixed or
-    explicitly suppressed with a reason. Running it inside tier-1 makes any
+    citizensassemblies_tpu/ bench.py tests/`` (the `make lint` scope) exits
+    0 — every pre-existing violation fixed or explicitly suppressed with a
+    reason, and no suppression stale. Running it inside tier-1 makes any
     NEW violation a test failure."""
     report = lint_paths(
-        [REPO_ROOT / "citizensassemblies_tpu"],
+        [
+            REPO_ROOT / "citizensassemblies_tpu",
+            REPO_ROOT / "bench.py",
+            REPO_ROOT / "tests",
+        ],
         root=REPO_ROOT,
         readme=REPO_ROOT / "README.md",
     )
