@@ -1,0 +1,124 @@
+"""Pallas TPU kernel for the ELL gather matvec (opt-in).
+
+STATUS — opt-in, like the fused sampler kernel (``kernels/sampler.py``): the
+XLA lowering of the ELL matvec pair (``solvers/sparse_ops``) is already a
+fused gather + reduction, so this kernel exists as the packaged example of
+keeping the packed operator VMEM-resident across a grid of column blocks —
+the layout a multi-matvec fusion (a whole PDHG block step in one kernel)
+would build on — not as the default dispatch path.
+
+Shape contract: the packed ``indices[C, k_pad]`` / ``values[C, k_pad]``
+arrays are tiled over a 1-D grid of column blocks; each program holds its
+``[block_c, k_pad]`` index/value tiles and the full gather source ``y``
+(the T-types vector — a few KB) in VMEM, computes the per-column gather sum
+``z[c] = Σ_s values[c, s] · y[indices[c, s]]`` and writes its ``[block_c]``
+slice of the output. Padding slots carry value 0, so they contribute
+nothing regardless of their index.
+
+Off-TPU the kernel runs under the Pallas interpreter (``interpret=None``
+auto-selects it), which is how the CPU test suite and the IR registration
+exercise it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _ell_gather_kernel(idx_ref, val_ref, y_ref, out_ref):
+    """One column block: gather the packed slots from the VMEM-resident
+    ``y`` row and reduce over the slot axis. Output is a [block_c, 128]
+    tile with column 0 meaningful (the lane-padded scalar idiom of
+    ``kernels/sampler.py``)."""
+    idx = idx_ref[:]  # [block_c, k_pad] int32
+    val = val_ref[:]  # [block_c, k_pad] f32
+    y = y_ref[0, :]  # [minor_pad] f32
+    gathered = jnp.take(y, idx, axis=0)  # [block_c, k_pad]
+    z = jnp.sum(val * gathered, axis=1, keepdims=True)  # [block_c, 1]
+    out_ref[:] = jnp.broadcast_to(z, out_ref.shape)
+
+
+@partial(jax.jit, static_argnames=("block_c", "interpret"))
+def _ell_gather_call(idx_p, val_p, y_p, block_c: int, interpret: bool):
+    C_pad, k_pad = idx_p.shape
+    minor_pad = y_p.shape[1]
+    grid = (C_pad // block_c,)
+    out = pl.pallas_call(
+        _ell_gather_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_c, k_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_c, k_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, minor_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_c, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((C_pad, 128), jnp.float32),
+        interpret=interpret,
+    )(idx_p, val_p, y_p)
+    return out[:, 0]
+
+
+def ell_gather_mv_pallas(
+    idx: np.ndarray,
+    val: np.ndarray,
+    y: np.ndarray,
+    block_c: int = 256,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """``(M y)[c] = Σ_s values[c,s] · y[indices[c,s]]`` via the Pallas
+    kernel. Drop-in for ``sparse_ops.ell_gather_mv`` (same contract; the
+    jitted XLA pair remains the production dispatch). Pads the column count
+    to the block multiple and the gather source to a lane multiple; both
+    pads are inert (zero values / zero source entries)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    idx = np.asarray(idx, np.int32)
+    val = np.asarray(val, np.float32)
+    y = np.asarray(y, np.float32)
+    C, k_pad = idx.shape
+    block_c = max(8, min(int(block_c), _round_up(max(C, 1), 8)))
+    C_pad = _round_up(max(C, 1), block_c)
+    minor_pad = _round_up(max(y.shape[0], 128), 128)
+    idx_p = np.zeros((C_pad, k_pad), np.int32)
+    idx_p[:C] = idx
+    val_p = np.zeros((C_pad, k_pad), np.float32)
+    val_p[:C] = val
+    y_p = np.zeros((1, minor_pad), np.float32)
+    y_p[0, : y.shape[0]] = y
+    out = _ell_gather_call(
+        jnp.asarray(idx_p), jnp.asarray(val_p), jnp.asarray(y_p),
+        block_c=block_c, interpret=bool(interpret),
+    )
+    return out[:C]
+
+
+@register_ir_core("kernels.pallas_ell_matvec")
+def _ir_pallas_ell_matvec() -> IRCase:
+    """The kernel at one minimum-padded shape, in interpret mode so it
+    lowers on CPU — the grid/VMEM structure (blocked packed operands, one
+    resident gather source) is what the IR pass pins."""
+    S = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+    C_pad, kp, minor_pad, block_c = 256, 16, 128, 64
+    return IRCase(
+        fn=_ell_gather_call,
+        args=(
+            S((C_pad, kp), i32), S((C_pad, kp), f32), S((1, minor_pad), f32),
+        ),
+        static=dict(block_c=block_c, interpret=True),
+    )

@@ -25,7 +25,7 @@ import dataclasses
 import importlib
 import inspect
 from pathlib import Path
-from typing import Any, Callable, Dict, List, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -55,12 +55,19 @@ class IRCase:
 
 @dataclasses.dataclass(frozen=True)
 class CoreEntry:
-    """One registered core: identity, provenance, and the lazy builder."""
+    """One registered core: identity, provenance, and the lazy builder.
+
+    ``dense_ref`` names the DENSE registry core this (structured-sparse)
+    core is the ELL twin of, registered at the SAME problem shape — the IR
+    pass then emits a measured dense→sparse flops/bytes delta for the pair
+    into the budget-diff artifact (``lint.ir.budget_diff``).
+    """
 
     name: str
     path: str  # repo-relative source file of the registration (reports)
     line: int  # line of the builder (file:line in PASS/FAIL output)
     build: Callable[[], IRCase]
+    dense_ref: Optional[str] = None
 
 
 #: name -> entry, populated by importing the MANIFEST modules
@@ -69,6 +76,7 @@ _REGISTRY: Dict[str, CoreEntry] = {}
 #: every module that registers at least one core. ``collect()`` imports
 #: these; keep the list sorted by package path so reports are deterministic.
 MANIFEST: Tuple[str, ...] = (
+    "citizensassemblies_tpu.kernels.ell_matvec",
     "citizensassemblies_tpu.kernels.sampler",
     "citizensassemblies_tpu.models.legacy",
     "citizensassemblies_tpu.parallel.solver",
@@ -90,12 +98,14 @@ def _rel_path(file: str) -> str:
         return str(p)
 
 
-def register_ir_core(name: str) -> Callable:
+def register_ir_core(name: str, dense_ref: Optional[str] = None) -> Callable:
     """Decorator: register ``build`` as the lazy IRCase builder for ``name``.
 
     The decorated function takes no arguments and returns an :class:`IRCase`;
     it may import jax freely (it only runs when the IR pass does). The
     registration's ``file:line`` is what the verifier reports for this core.
+    ``dense_ref`` marks this core as the structured-sparse (ELL) twin of a
+    dense core registered at the same shape (see :class:`CoreEntry`).
     """
 
     def deco(build: Callable[[], IRCase]) -> Callable[[], IRCase]:
@@ -105,10 +115,19 @@ def register_ir_core(name: str) -> Callable:
             path=_rel_path(src),
             line=build.__code__.co_firstlineno,
             build=build,
+            dense_ref=dense_ref,
         )
         return build
 
     return deco
+
+
+def sparse_pairs() -> Dict[str, str]:
+    """``{ell core name: dense twin name}`` for every registered pair —
+    the budget-diff artifact's dense→sparse delta table keys off this."""
+    return {
+        name: e.dense_ref for name, e in _REGISTRY.items() if e.dense_ref
+    }
 
 
 def collect() -> List[CoreEntry]:

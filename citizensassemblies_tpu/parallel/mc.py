@@ -30,10 +30,16 @@ from jax.sharding import PartitionSpec as P
 from citizensassemblies_tpu.core.instance import DenseInstance
 from citizensassemblies_tpu.models.legacy import _sample_panels_kernel, chain_keys_for
 from citizensassemblies_tpu.parallel.mesh import shard_map_compat
+from citizensassemblies_tpu.utils.memo import LRU
 
-_DRAW_CACHE: dict = {}
-_ROUND_CACHE: dict = {}
-_MATVEC_CACHE: dict = {}
+# LRU-bounded (utils/memo): keys embed the Mesh object, so a session that
+# recreates meshes (sweeps, dry runs, bench rows) would otherwise leak one
+# set of lowered executables per mesh instance forever. Evictions are
+# counted process-wide (memo_evictions()); a re-built wrapper after an
+# eviction re-lowers once, exactly like a first call.
+_DRAW_CACHE: LRU = LRU(cap=8, name="mc_draw")
+_ROUND_CACHE: LRU = LRU(cap=8, name="mc_round")
+_MATVEC_CACHE: LRU = LRU(cap=8, name="mc_matvec")
 
 
 def _draw_callable(mesh: Mesh, B_local: int, sharded_scores: bool):

@@ -47,6 +47,7 @@ from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.parallel.mesh import shard_map_compat
 from citizensassemblies_tpu.solvers.highs_backend import DualSolution
 from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.memo import LRU
 
 
 def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
@@ -171,7 +172,147 @@ def _sharded_core(mesh: Mesh, axes, block_iters: int, max_blocks: int):
     return solve
 
 
-_CORE_CACHE: dict = {}
+def _sharded_core_ell(mesh: Mesh, axes, block_iters: int, max_blocks: int):
+    """The mesh-sharded dual-LP PDHG on the ELL rep of the row block.
+
+    Same solve as :func:`_sharded_core` with the local inequality rows
+    supplied as packed ELL indices/values (``solvers/sparse_ops`` row form:
+    one packed row per portfolio panel, minor axis = the nv variables):
+    ``Gs_l @ x`` is a local per-row gather sum, ``Gs_lᵀ λ`` a local
+    ``segment_sum`` followed by the same one ``psum`` as the dense core,
+    and the Ruiz column maxima are ``segment_max`` partials ``pmax``-reduced
+    over the mesh. The tunnel ships ``rows_local × k_pad`` packed arrays
+    instead of the dense ``rows_local × nv`` shard.
+    """
+
+    @partial(
+        shard_map_compat,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(axes), P(), P(), P(), P()),
+        out_specs=(P(), P(axes), P(), P()),
+    )
+    def solve(idx_l, val_l, h_l, c, a_row, b, tol):
+        from citizensassemblies_tpu.solvers.sparse_ops import (
+            ell_gather_mv,
+            ell_scatter_mv,
+        )
+
+        f32 = jnp.float32
+        val_l = val_l.astype(f32)
+        h_l = h_l.astype(f32)
+        c = c.astype(f32)
+        a_row = a_row.astype(f32)
+        nv = c.shape[0]
+        absV = jnp.abs(val_l)
+
+        # ---- Ruiz equilibration on the packed shard ---------------------
+        def ruiz_body(_, carry):
+            d_r_l, d_c = carry
+            S = absV * d_r_l[:, None] * d_c[idx_l]
+            rmax = S.max(axis=1)
+            cmax_l = jnp.maximum(
+                jax.ops.segment_max(
+                    S.ravel(), idx_l.ravel(), num_segments=nv
+                ),
+                0.0,
+            )
+            cmax = jax.lax.pmax(cmax_l, axes)
+            cmax = jnp.maximum(cmax, jnp.abs(a_row) * d_c)
+            rn = jnp.where(rmax > 0, jnp.sqrt(jnp.maximum(rmax, 1e-10)), 1.0)
+            cn = jnp.where(cmax > 0, jnp.sqrt(jnp.maximum(cmax, 1e-10)), 1.0)
+            return d_r_l / rn, d_c / cn
+
+        d_r_l, d_c = jax.lax.fori_loop(
+            0, 8, ruiz_body,
+            (jnp.ones(idx_l.shape[0], f32), jnp.ones(nv, f32)),
+        )
+        vals_s = val_l * d_r_l[:, None] * d_c[idx_l]
+        hs_l = h_l * d_r_l
+        cs = c * d_c
+        as_row = a_row * d_c
+        bs = b.astype(f32)
+
+        def G_mv(x):
+            return ell_gather_mv(idx_l, vals_s, x)
+
+        def G_rmv_psum(y_l):
+            return jax.lax.psum(
+                ell_scatter_mv(idx_l, vals_s, y_l, nv), axes
+            )
+
+        # ---- ‖K‖₂ power estimate, psum-reduced --------------------------
+        def pow_body(_, v):
+            w = G_rmv_psum(G_mv(v)) + as_row * (as_row @ v)
+            return w / (jnp.linalg.norm(w) + 1e-12)
+
+        v = jax.lax.fori_loop(
+            0, 24, pow_body, jnp.ones(nv, f32) / jnp.sqrt(nv * 1.0)
+        )
+        norm = jnp.sqrt(
+            jnp.linalg.norm(G_rmv_psum(G_mv(v)) + as_row * (as_row @ v))
+            + 1e-12
+        )
+        tau = 0.9 / norm
+        sigma = 0.9 / norm
+        cnorm = jnp.linalg.norm(cs)
+        hnorm = jnp.sqrt(jax.lax.psum(jnp.sum(hs_l**2), axes))
+        scale = 1.0 + cnorm + hnorm + jnp.abs(bs[0])
+
+        def kkt(x, lam_l, mu):
+            pri_l = jnp.sum(jnp.maximum(G_mv(x) - hs_l, 0.0) ** 2)
+            pri = jnp.sqrt(jax.lax.psum(pri_l, axes) + (as_row @ x - bs[0]) ** 2)
+            grad = cs + G_rmv_psum(lam_l) + as_row * mu[0]
+            dua = jnp.linalg.norm(jnp.minimum(grad, 0.0))
+            pobj = cs @ x
+            dobj = -jax.lax.psum(lam_l @ hs_l, axes) - mu[0] * bs[0]
+            gap = jnp.abs(pobj - dobj)
+            return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+
+        def one_iter(carry, _):
+            x, lam_l, mu, xs, ls, ms = carry
+            grad = cs + G_rmv_psum(lam_l) + as_row * mu[0]
+            x_new = jnp.maximum(x - tau * grad, 0.0)
+            xb = 2.0 * x_new - x
+            lam_l = jnp.maximum(lam_l + sigma * (G_mv(xb) - hs_l), 0.0)
+            mu = mu + sigma * (jnp.array([as_row @ xb]) - bs)
+            return (x_new, lam_l, mu, xs + x_new, ls + lam_l, ms + mu), None
+
+        def block(state):
+            x, lam_l, mu, xa, la, ma, it, res = state
+            zero = (jnp.zeros_like(x), jnp.zeros_like(lam_l), jnp.zeros_like(mu))
+            (x, lam_l, mu, xs, ls, ms), _ = jax.lax.scan(
+                one_iter, (x, lam_l, mu) + zero, None, length=block_iters
+            )
+            inv = 1.0 / block_iters
+            xa = (xa + xs * inv) * 0.5
+            la = (la + ls * inv) * 0.5
+            ma = (ma + ms * inv) * 0.5
+            r_cur = kkt(x, lam_l, mu)
+            r_avg = kkt(xa, la, ma)
+            better = r_avg < r_cur
+            x = jnp.where(better, xa, x)
+            lam_l = jnp.where(better, la, lam_l)
+            mu = jnp.where(better, ma, mu)
+            return (x, lam_l, mu, xa, la, ma, it + 1, jnp.minimum(r_cur, r_avg))
+
+        def cond(state):
+            *_, it, res = state
+            return (res > tol[0]) & (it < max_blocks)
+
+        x0 = jnp.zeros(nv, f32)
+        lam0 = jnp.zeros(idx_l.shape[0], f32)
+        mu0 = jnp.zeros(1, f32)
+        state = (x0, lam0, mu0, x0, lam0, mu0, jnp.int32(0), jnp.float32(jnp.inf))
+        x, lam_l, mu, _, _, _, _it, res = jax.lax.while_loop(cond, block, state)
+        return x * d_c, lam_l * d_r_l, mu, jnp.array([res])
+
+    return solve
+
+
+#: COMPILED-program cache, keyed per (mesh, variant, block schedule) and
+#: LRU-bounded: recreating meshes in a long session must not accrete
+#: executables (evictions land in utils.memo.memo_evictions())
+_CORE_CACHE: LRU = LRU(cap=8, name="sharded_pdhg_cores")
 
 
 def _get_sharded_jit(mesh: Mesh, block_iters: int, max_blocks: int):
@@ -179,12 +320,28 @@ def _get_sharded_jit(mesh: Mesh, block_iters: int, max_blocks: int):
     (mesh, block schedule) — shared by the production marshalling below and
     the IR verifier's registration, so both see the same jitted object."""
     axes = mesh.axis_names
-    key = (mesh, axes, block_iters, max_blocks)
+    key = (mesh, axes, "dense", block_iters, max_blocks)
     core = _CORE_CACHE.get(key)
     if core is None:
         core = jax.jit(
             _sharded_core(mesh, axes, block_iters, max_blocks),
             donate_argnums=(1,),
+        )
+        _CORE_CACHE[key] = core
+    return core
+
+
+def _get_sharded_jit_ell(mesh: Mesh, block_iters: int, max_blocks: int):
+    """ELL twin of :func:`_get_sharded_jit` (``h`` donated: it is
+    shape/sharding-matched with the returned λ shard, as in the dense
+    program)."""
+    axes = mesh.axis_names
+    key = (mesh, axes, "ell", block_iters, max_blocks)
+    core = _CORE_CACHE.get(key)
+    if core is None:
+        core = jax.jit(
+            _sharded_core_ell(mesh, axes, block_iters, max_blocks),
+            donate_argnums=(2,),
         )
         _CORE_CACHE[key] = core
     return core
@@ -207,6 +364,25 @@ def _ir_sharded_dual_lp() -> IRCase:
             S((nv,), f32), S((1,), f32), S((1,), f32),
         ),
         donate_expected=1,  # h (shape/sharding-matched with the λ shard)
+    )
+
+
+@register_ir_core("parallel.sharded_dual_lp_ell", dense_ref="parallel.sharded_dual_lp")
+def _ir_sharded_dual_lp_ell() -> IRCase:
+    """The ELL twin at the dense registration's (rows, nv) shape, packed at
+    k_pad = 8 slots — same one-device mesh so the budgets stay
+    environment-independent and the dense→sparse delta is same-shape."""
+    S = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("ir_rows",))
+    rows, nv, kp = 64, 33, 8
+    return IRCase(
+        fn=_get_sharded_jit_ell(mesh, block_iters=128, max_blocks=8),
+        args=(
+            S((rows, kp), i32), S((rows, kp), f32), S((rows,), f32),
+            S((nv,), f32), S((nv,), f32), S((1,), f32), S((1,), f32),
+        ),
+        donate_expected=1,  # h, as in the dense program
     )
 
 
@@ -252,6 +428,40 @@ def _run_core(
         return core(G_dev, h_dev, c_dev, a_dev, b_dev, tol_dev)
 
 
+def _run_core_ell(
+    mesh: Mesh,
+    idx: np.ndarray,
+    val: np.ndarray,
+    h: np.ndarray,
+    c: np.ndarray,
+    a_row: np.ndarray,
+    b: np.ndarray,
+    tol: float,
+    block_iters: int,
+    max_blocks: int,
+    cfg: Optional[Config] = None,
+):
+    """:func:`_run_core` for the ELL program: the packed index/value shards
+    upload pre-partitioned over the row axis, everything else replicated —
+    same guard, donation and executable-reuse contract."""
+    axes = mesh.axis_names
+    core = _get_sharded_jit_ell(mesh, block_iters, max_blocks)
+    row_sharding = NamedSharding(mesh, P(axes, None))
+    vec_sharding = NamedSharding(mesh, P(axes))
+    rep_sharding = NamedSharding(mesh, P())
+    idx_dev = jax.device_put(np.asarray(idx, np.int32), row_sharding)
+    val_dev = jax.device_put(np.asarray(val, np.float32), row_sharding)
+    h_dev = jax.device_put(np.asarray(h, np.float32), vec_sharding)
+    c_dev = jax.device_put(np.asarray(c, np.float32), rep_sharding)
+    a_dev = jax.device_put(np.asarray(a_row, np.float32), rep_sharding)
+    b_dev = jax.device_put(np.asarray(b, np.float32), rep_sharding)
+    tol_dev = jax.device_put(np.asarray([tol], np.float32), rep_sharding)
+    from citizensassemblies_tpu.utils.guards import no_implicit_transfers
+
+    with no_implicit_transfers(cfg):
+        return core(idx_dev, val_dev, h_dev, c_dev, a_dev, b_dev, tol_dev)
+
+
 def solve_dual_lp_pdhg_sharded(
     P_mat: np.ndarray,
     fixed: np.ndarray,
@@ -286,10 +496,26 @@ def solve_dual_lp_pdhg_sharded(
     b = np.array([1.0])
     c = np.concatenate([-fixed_vals, [1.0]])
 
-    x, lam, mu, res = _run_core(
-        mesh, G, np.zeros(rows, dtype=np.float32), c, a_row, b, tol,
-        block_iters, max_blocks, cfg=cfg,
+    # sparse routing: the rows are panels (k members + the ŷ column), so
+    # the fill is ≈ k/n — at the portfolio sizes that reach this path the
+    # ELL shard ships and streams a small fraction of the dense bytes
+    from citizensassemblies_tpu.solvers.sparse_ops import (
+        ell_pack_rows,
+        sparse_enabled,
     )
+
+    fill = float(np.count_nonzero(G)) / max(G.size, 1)
+    if sparse_enabled(cfg, fill):
+        idx_r, val_r, _nnz = ell_pack_rows(G)
+        x, lam, mu, res = _run_core_ell(
+            mesh, idx_r, val_r, np.zeros(rows, dtype=np.float32), c, a_row,
+            b, tol, block_iters, max_blocks, cfg=cfg,
+        )
+    else:
+        x, lam, mu, res = _run_core(
+            mesh, G, np.zeros(rows, dtype=np.float32), c, a_row, b, tol,
+            block_iters, max_blocks, cfg=cfg,
+        )
     x = np.asarray(x, dtype=np.float64)
     res_f = float(np.asarray(res)[0])
     y = x[:n]

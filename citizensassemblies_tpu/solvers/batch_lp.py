@@ -58,6 +58,7 @@ import numpy as np
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
+from citizensassemblies_tpu.utils.memo import LRU
 
 
 @dataclasses.dataclass
@@ -120,8 +121,10 @@ def lp_batch_enabled(cfg: Optional[Config]) -> bool:
 # --- the vmapped core --------------------------------------------------------
 
 #: memoized jitted cores per (max_iters, check_every): one vmapped program
-#: whose jit cache then holds one executable per padded bucket shape
-_BATCH_CORES: Dict[Tuple[int, int], object] = {}
+#: whose jit cache then holds one executable per padded bucket shape.
+#: LRU-bounded (utils/memo): a sweep over iteration schedules must not
+#: accrete executables forever — evictions land in ``memo_evictions()``.
+_BATCH_CORES: LRU = LRU(cap=6, name="batch_lp_cores")
 
 #: per-bucket dispatch / compile bookkeeping, for the bench's
 #: solves-per-dispatch and per-bucket compile evidence
@@ -392,6 +395,188 @@ def solve_lp_batch(
             )
             if warm_key is not None:
                 _WARM_SLOTS[(warm_key, i)] = (xi, li, mi, int(inst.tail_vars))
+    return out
+
+
+# --- structured-sparse (ELL) polish-face screen ------------------------------
+
+#: memoized vmapped ELL two-sided cores per iteration schedule — the
+#: bucketed engine's sparse variant (LRU-bounded like _BATCH_CORES)
+_POLISH_ELL_CORES: LRU = LRU(cap=6, name="polish_ell_cores")
+
+
+def _get_polish_screen_ell_core(max_iters: int, check_every: int):
+    """Build (once per schedule) the vmapped ELL two-sided master core.
+
+    The per-lane body is ``lp_pdhg._pdhg_two_sided_body_ell`` verbatim;
+    ``vmap`` broadcasts the PACKED indices/values and the profile ``v``
+    (in_axes=None) and maps the per-lane (colmask, warm triple, tol) — the
+    nested polish prefixes differ only in their column mask, so one shared
+    pack feeds every lane and the whole screen is one device dispatch over
+    O(C·k_pad) data instead of a stacked dense ``[B, 2T, C+1]`` tensor.
+    """
+    key = (int(max_iters), int(check_every))
+    core = _POLISH_ELL_CORES.get(key)
+    if core is None:
+        from functools import partial
+
+        import jax
+
+        from citizensassemblies_tpu.solvers.lp_pdhg import (
+            _pdhg_two_sided_body_ell,
+        )
+
+        one = partial(
+            _pdhg_two_sided_body_ell, max_iters=key[0], check_every=key[1]
+        )
+        core = jax.jit(
+            jax.vmap(one, in_axes=(None, None, None, 0, 0, 0, 0, 0)),
+            donate_argnums=(4, 5),  # stacked x0/lam0 (mu0 scalar lanes stay)
+        )
+        _POLISH_ELL_CORES[key] = core
+    return core
+
+
+@register_ir_core("batch_lp.polish_screen_dense")
+def _ir_polish_screen_dense() -> IRCase:
+    """The DENSE comparator of the ELL polish screen: the generic vmapped
+    core at the stacked two-sided master shape (B=4 lanes of a T=128,
+    C=256 face — G is the dense ``[2T, C+1]`` block). Registered at the
+    same problem shape as ``batch_lp.polish_screen_ell`` so the budget
+    diff's dense→sparse delta is a same-shape measurement."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    B, T, C = 4, 128, 256
+    m1, m2, nv = 2 * T, 1, C + 1
+    return IRCase(
+        fn=_get_batch_core(1024, 128),
+        args=(
+            S((B, nv), f32), S((B, m1, nv), f32), S((B, m1), f32),
+            S((B, m2, nv), f32), S((B, m2), f32),
+            S((B, nv), f32), S((B, m1), f32), S((B, m2), f32), S((B,), f32),
+        ),
+        donate_expected=3,
+    )
+
+
+@register_ir_core("batch_lp.polish_screen_ell", dense_ref="batch_lp.polish_screen_dense")
+def _ir_polish_screen_ell() -> IRCase:
+    """The ELL polish screen at the same (B=4, T=128, C=256) shape, packed
+    at k_pad=16 slots — the production-representative fill."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+    B, T, C, kp = 4, 128, 256, 16
+    return IRCase(
+        fn=_get_polish_screen_ell_core(1024, 128),
+        args=(
+            S((C, kp), i32), S((C, kp), f32), S((T,), f32),
+            S((B, C), f32), S((B, C + 1), f32), S((B, 2 * T), f32),
+            S((B,), f32), S((B,), f32),
+        ),
+        donate_expected=2,  # stacked x0, lam0
+    )
+
+
+def solve_polish_screen_ell(
+    ell,
+    v: np.ndarray,
+    caps: Sequence[int],
+    warms: Sequence[Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]],
+    tol: float,
+    max_iters: int,
+    cfg: Optional[Config] = None,
+    log=None,
+):
+    """Solve nested polish-face prefixes as ONE vmapped ELL dispatch.
+
+    ``ell`` packs the support columns
+    (:class:`~citizensassemblies_tpu.solvers.sparse_ops.EllPack`, minor =
+    the T types); ``caps`` are the prefix column counts (one lane each,
+    expressed as per-lane column masks over the SHARED pack); ``warms``
+    supplies each lane's (x, λ, μ) warm triple at its real size, or None.
+    Returns a list of
+    :class:`~citizensassemblies_tpu.solvers.lp_pdhg.LPSolution` in cap
+    order, with the same ``x = [p (Cp), ε]`` layout as the serial ELL
+    master so callers slice ``x[:cap]`` and certify arithmetically.
+    """
+    import jax.numpy as jnp
+
+    from citizensassemblies_tpu.solvers.lp_pdhg import LPSolution
+
+    cfg = cfg or default_config()
+    T = int(ell.minor)
+    S_real = len(ell)
+    cap_dim = max(int(getattr(cfg, "lp_batch_bucket_max", 4096)), _BUCKET_FLOOR)
+    Cp = _bucket_dim(S_real, cap_dim)
+    idx_p, val_p = ell.padded(Cp)
+    B_real = len(caps)
+    B = 1 << max(B_real - 1, 0).bit_length()
+    f32 = np.float32
+    colmask = np.zeros((B, Cp), f32)
+    x0 = np.zeros((B, Cp + 1), f32)
+    lam0 = np.zeros((B, 2 * T), f32)
+    mu0 = np.zeros(B, f32)
+    tols = np.full(B, _PAD_TOL, f32)
+    for lane, c_ in enumerate(caps):
+        colmask[lane, : int(c_)] = 1.0
+        tols[lane] = float(tol)
+        warm = warms[lane] if lane < len(warms) else None
+        if warm is not None:
+            x_w, l_w, m_w = warm
+            m = min(int(c_), len(x_w) - 1)
+            x0[lane, :m] = x_w[:m]
+            x0[lane, Cp] = max(float(x_w[-1]), 0.0)
+            lam0[lane, : min(2 * T, len(l_w))] = l_w[: 2 * T]
+            mu0[lane] = float(m_w[0] if np.ndim(m_w) else m_w)
+
+    core = _get_polish_screen_ell_core(int(max_iters), int(cfg.pdhg_check_every))
+    bkey = f"ell_{T}x{Cp}x{ell.k_pad}x{B}"
+    stats = _BUCKET_STATS.setdefault(
+        bkey, {"dispatches": 0, "solves": 0, "compiles": 0}
+    )
+    operands = (
+        jnp.asarray(idx_p), jnp.asarray(val_p), jnp.asarray(v, jnp.float32),
+        jnp.asarray(colmask), jnp.asarray(x0), jnp.asarray(lam0),
+        jnp.asarray(mu0), jnp.asarray(tols),
+    )
+    with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
+        with no_implicit_transfers(cfg):
+            x, lam, mu, it, res = core(*operands)
+        x = np.asarray(x, dtype=np.float64)
+        lam = np.asarray(lam, dtype=np.float64)
+        mu = np.asarray(mu, dtype=np.float64)
+        it = np.asarray(it)
+        res = np.asarray(res)
+    stats["dispatches"] += 1
+    stats["solves"] += B_real
+    stats["compiles"] += guard.count
+    if log is not None:
+        log.count("lp_batch_dispatches")
+        log.count("lp_batch_solves", B_real)
+        if B > B_real:
+            log.count("lp_batch_pad_lanes", B - B_real)
+        if guard.count:
+            log.count(f"lp_batch_compiles_{bkey}", guard.count)
+    out = []
+    for lane, c_ in enumerate(caps):
+        res_l = float(res[lane])
+        out.append(
+            LPSolution(
+                ok=bool(res_l <= float(tol) * 4.0),
+                x=x[lane],
+                lam=lam[lane],
+                mu=mu[lane][None] if np.ndim(mu[lane]) == 0 else mu[lane],
+                objective=float(x[lane][Cp]),
+                iters=int(it[lane]),
+                kkt=res_l,
+            )
+        )
     return out
 
 

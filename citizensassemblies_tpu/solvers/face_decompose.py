@@ -426,12 +426,17 @@ def _master_pdhg(
     warm,
     max_iters: int,
     tol: float,
+    ell=None,
 ) -> Tuple[float, np.ndarray, np.ndarray, float, Optional[tuple], bool]:
     """One approximate master solve on device: the two-sided ε-LP handed to
     the STRUCTURED warm-started PDHG core (``lp_pdhg.solve_two_sided_master``
     — only MT is shipped and kept resident; the ± row structure is applied
     arithmetically, halving both the tunnel transfer and the per-iteration
-    HBM traffic of the stacked-matrix formulation).
+    HBM traffic of the stacked-matrix formulation). With ``ell`` (the
+    incrementally-maintained ELL pack of the master columns,
+    ``solvers/sparse_ops``), the sparse core carries the solve instead:
+    the tunnel ships only the NEW columns' packed indices/values since the
+    last round, and every PDHG matvec is O(C·k_pad) gather/scatter work.
 
     Returns ``(eps_realized, w, p_norm, eps_obj, warm', ok)`` where
     ``eps_realized = ‖M p_norm − v‖∞`` is the *arithmetic* certificate of the
@@ -441,12 +446,20 @@ def _master_pdhg(
     own convergence flag. Columns are bucket-padded so the jitted core
     compiles once per bucket (same idiom as ``solve_stage_lp_pdhg``).
     """
-    from citizensassemblies_tpu.solvers.lp_pdhg import solve_two_sided_master
+    from citizensassemblies_tpu.solvers.lp_pdhg import (
+        solve_two_sided_master,
+        solve_two_sided_master_ell,
+    )
 
     T, C = MT.shape
-    sol = solve_two_sided_master(
-        MT, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters
-    )
+    if ell is not None:
+        sol = solve_two_sided_master_ell(
+            ell, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters
+        )
+    else:
+        sol = solve_two_sided_master(
+            MT, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters
+        )
     p = np.maximum(sol.x[:C], 0.0)
     total = p.sum()
     if not np.isfinite(total) or total <= 0.0:
@@ -682,6 +695,36 @@ def realize_profile(
     for c in seed_comps:
         add(c)
 
+    # --- structured-sparse master state (solvers/sparse_ops) ----------------
+    # Master columns are compositions: ≤ k nonzeros of T types, so at the
+    # large-T regimes (sf_e mild-skew T=565, household quotient T=1199) the
+    # dense MT is ≥90 % zeros. The ELL pack is maintained INCREMENTALLY in
+    # lockstep with ``cols``: appends pack only the new columns
+    # (``ell_synced``), a prune subsets by fancy indexing, and only a
+    # column-set replacement from ``best`` invalidates it. Fill is measured
+    # per master; the auto gate (``Config.sparse_ops``) decides per solve.
+    from citizensassemblies_tpu.solvers.sparse_ops import EllPack, sparse_enabled
+
+    sparse_try = accel and getattr(cfg, "sparse_ops", None) is not False
+    ell_pack: Optional[EllPack] = EllPack(minor=T) if sparse_try else None
+
+    def ell_synced() -> Optional[EllPack]:
+        """Append any columns added since the last sync (packs ONLY those);
+        returns the pack, or None when the sparse path is off."""
+        nonlocal ell_pack
+        if ell_pack is None:
+            return None
+        if len(ell_pack) > len(cols):  # pragma: no cover - defensive
+            ell_pack = EllPack(minor=T)
+        if len(ell_pack) < len(cols):
+            with log.timer("sparse_pack"):
+                new = (
+                    np.stack(cols[len(ell_pack) :]).astype(np.float64)
+                    / m[None, :]
+                )
+                ell_pack.append(new)
+        return ell_pack
+
     def top_mass(p: np.ndarray, cap: int = 2048, frac: float = 1.0 - 1e-10):
         """Indices of the smallest column set carrying ``frac`` of the mass.
 
@@ -738,12 +781,30 @@ def realize_profile(
         C_sup = np.stack([cols[i] for i in sup]).astype(np.int32)
         MTs = np.ascontiguousarray((C_sup.astype(np.float64) / m[None, :]).T)
         the_bar = bar if bar is not None else stalled_band
+        # ELL pack of the support: a pure subset of the synced incremental
+        # pack when the iterate still corresponds to ``cols`` (no re-pack at
+        # all), a fresh pack otherwise; the fill gate then decides per solve
+        ell_sup = None
+        if sparse_try:
+            if (
+                ell_pack is not None
+                and p_now is not None
+                and len(p_now) == len(cols)
+                and len(ell_pack) == len(cols)
+            ):
+                cand_pack = ell_pack.take(sup)
+            else:
+                with log.timer("sparse_pack"):
+                    cand_pack = EllPack.from_rows(MTs.T, minor=T)
+            if sparse_enabled(cfg, cand_pack.fill):
+                ell_sup = cand_pack
         if accel and batch_screen and len(sup) > _POLISH_SCREEN_MIN_SUP:
             # batched polish-face screen: nested support prefixes solved as
             # one padded vmapped dispatch, each judged by its own float64
             # arithmetic residual — identical accept-bar semantics
             from citizensassemblies_tpu.solvers.batch_lp import (
                 solve_lp_batch,
+                solve_polish_screen_ell,
                 two_sided_master_batch_lp,
             )
 
@@ -752,29 +813,48 @@ def realize_profile(
             # 2048 columns) — the small faces converge in a fraction of the
             # deep solve's iterations when they already realize v
             caps = sorted({max(len(sup) // 4, 1), max(len(sup) // 2, 1), len(sup)})
-            insts = []
-            for c_ in caps:
-                inst = two_sided_master_batch_lp(
-                    MTs[:, :c_], v, tol=0.25 * master_tol
-                )
-                if (
-                    cfg.decomp_warm_start
-                    and master_warm is not None
-                    and p_now is not None
-                    and len(p_now) == len(cols)
-                ):
-                    x0 = np.concatenate(
-                        [p_now[sup[:c_]], [max(float(master_warm[0][-1]), 0.0)]]
+            warm_ok = (
+                cfg.decomp_warm_start
+                and master_warm is not None
+                and p_now is not None
+                and len(p_now) == len(cols)
+            )
+            if ell_sup is not None:
+                # sparse screen: ONE shared pack feeds every prefix lane —
+                # the lanes differ only in their column mask
+                warms = []
+                for c_ in caps:
+                    if warm_ok:
+                        x0 = np.concatenate(
+                            [p_now[sup[:c_]], [max(float(master_warm[0][-1]), 0.0)]]
+                        )
+                        warms.append((x0, master_warm[1], master_warm[2]))
+                    else:
+                        warms.append(None)
+                with log.timer("decomp_polish_screen"):
+                    sols = solve_polish_screen_ell(
+                        ell_sup, v, caps, warms, tol=0.25 * master_tol,
+                        max_iters=24_576, cfg=cfg, log=log,
                     )
-                    inst.warm = (x0, master_warm[1], master_warm[2])
-                insts.append(inst)
-            with log.timer("decomp_polish_screen"):
-                # one SHARED bucket: the nested prefixes differ only in
-                # column count, and one fused dispatch is the whole point
-                sols = solve_lp_batch(
-                    insts, cfg=cfg, log=log, warm_key="decomp_polish_screen",
-                    max_iters=24_576, common_bucket=True,
-                )
+            else:
+                insts = []
+                for c_ in caps:
+                    inst = two_sided_master_batch_lp(
+                        MTs[:, :c_], v, tol=0.25 * master_tol
+                    )
+                    if warm_ok:
+                        x0 = np.concatenate(
+                            [p_now[sup[:c_]], [max(float(master_warm[0][-1]), 0.0)]]
+                        )
+                        inst.warm = (x0, master_warm[1], master_warm[2])
+                    insts.append(inst)
+                with log.timer("decomp_polish_screen"):
+                    # one SHARED bucket: the nested prefixes differ only in
+                    # column count, and one fused dispatch is the whole point
+                    sols = solve_lp_batch(
+                        insts, cfg=cfg, log=log, warm_key="decomp_polish_screen",
+                        max_iters=24_576, common_bucket=True,
+                    )
             lp_solves += 1
             best_s = None
             for c_, sol in zip(caps, sols):
@@ -794,6 +874,7 @@ def realize_profile(
         if accel:
             from citizensassemblies_tpu.solvers.lp_pdhg import (
                 solve_two_sided_master,
+                solve_two_sided_master_ell,
             )
 
             warm_s = None
@@ -811,10 +892,16 @@ def realize_profile(
                 )
                 warm_s = (x0, master_warm[1], master_warm[2])
                 log.count("decomp_polish_warm")
-            sol = solve_two_sided_master(
-                MTs, v, cfg=cfg, warm=warm_s, tol=0.25 * master_tol,
-                max_iters=98_304,
-            )
+            if ell_sup is not None:
+                sol = solve_two_sided_master_ell(
+                    ell_sup, v, cfg=cfg, warm=warm_s, tol=0.25 * master_tol,
+                    max_iters=98_304,
+                )
+            else:
+                sol = solve_two_sided_master(
+                    MTs, v, cfg=cfg, warm=warm_s, tol=0.25 * master_tol,
+                    max_iters=98_304,
+                )
             lp_solves += 1
             p_s = np.maximum(sol.x[: MTs.shape[1]], 0.0)
             tot = p_s.sum()
@@ -959,10 +1046,21 @@ def realize_profile(
                         "decomp_master_warm" if warm_arg is not None
                         else "decomp_master_cold"
                     )
+                    # sparse routing: sync the incremental pack (only new
+                    # columns re-pack), then gate on the measured fill
+                    ell_now = ell_synced()
+                    use_sparse = False
+                    if ell_now is not None:
+                        use_sparse = sparse_enabled(cfg, ell_now.fill)
+                        log.gauge(
+                            "sparse_fill_pct", int(round(100 * ell_now.fill))
+                        )
+                        log.count("sparse_hit" if use_sparse else "sparse_miss")
                     with log.timer("decomp_master"):
                         eps, w, p, eps_obj, pdhg_warm, _ok = _master_pdhg(
                             MT, v, cfg, warm_arg,
                             max_iters=4_096 if far else 12_288, tol=master_tol,
+                            ell=ell_now if use_sparse else None,
                         )
                     lp_solves += 1
                     polish_warm = pdhg_warm
@@ -1053,12 +1151,23 @@ def realize_profile(
             sup_idx = top_mass(p)  # mass-ordered, largest first
             # prune BEFORE expanding: the next master sees only the
             # mass-bearing support plus this round's additions
+            n_before = len(cols)
             kept = [cols[i] for i in sup_idx]
             kept_p = p[sup_idx]
             cols.clear()
             seen.clear()
             for c in kept:
                 add(c)
+            if ell_pack is not None:
+                # the prune is a pure subset/reorder: fancy-index the packed
+                # arrays instead of re-packing (EllPack.take); a pack that
+                # was out of sync (host-master rounds) restarts empty and
+                # re-packs lazily at the next device master
+                ell_pack = (
+                    ell_pack.take(sup_idx)
+                    if len(ell_pack) == n_before
+                    else EllPack(minor=T)
+                )
             # re-align the PDHG warm start with the pruned column order (kept
             # columns keep their primal mass; fresh columns start at zero)
             if pdhg_warm is not None:
@@ -1138,6 +1247,11 @@ def realize_profile(
             C_best, p_best, _ = best
             cols = [c for c in C_best]
             p = p_best
+            if ell_pack is not None:
+                # the column set was REPLACED (not appended/pruned): the
+                # incremental pack no longer corresponds — drop it and let
+                # the final polish re-pack its support from scratch
+                ell_pack = EllPack(minor=T)
         with log.timer("decomp_polish"):
             # final polish at the TIGHT bar: stalled-band acceptance is the
             # in-loop deep path's explicit fallback criterion; the shipped

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.utils.memo import LRU
 
 
 @jax.jit
@@ -75,9 +76,64 @@ def _min_norm_dual_ascent(P, t, eps, lr, lam0, iters: int):
     return p_of(lam), lam
 
 
+# lam0 donated exactly as in the dense ascent
+@partial(jax.jit, static_argnames=("iters",), donate_argnums=(5,))
+def _min_norm_dual_ascent_ell(idx, val, t, eps, lr, lam0, iters: int):
+    """:func:`_min_norm_dual_ascent` on the ELL rep of the portfolio.
+
+    ``idx``/``val`` pack P's ROWS (each panel: exactly k member columns of
+    the n agents, ``solvers/sparse_ops``), so ``P @ w`` is a per-row gather
+    sum and ``Pᵀ p`` a ``segment_sum`` — O(C·k) per iteration instead of
+    O(C·n), on a 20k-iteration loop. Same two-sided multiplier semantics
+    and return contract as the dense ascent."""
+    from citizensassemblies_tpu.solvers.sparse_ops import (
+        ell_gather_mv,
+        ell_scatter_mv,
+    )
+
+    n = t.shape[0]
+
+    def p_of(lam):
+        return project_simplex(ell_gather_mv(idx, val, lam[:n] - lam[n:]) / 2.0)
+
+    def body(_, lam):
+        p = p_of(lam)
+        alloc = ell_scatter_mv(idx, val, p, n)
+        resid_lo = (t - eps) - alloc
+        resid_up = alloc - (t + eps)
+        return jnp.maximum(lam + lr * jnp.concatenate([resid_lo, resid_up]), 0.0)
+
+    lam = jax.lax.fori_loop(0, iters, body, lam0)
+    return p_of(lam), lam
+
+
+def _ell_power_norm(idx, val, n: int, iters: int = 40):
+    """‖P‖₂ power estimate via the ELL matvec pair (the dense
+    ``lp_pdhg._power_norm`` semantics on the packed rep)."""
+    from citizensassemblies_tpu.solvers.sparse_ops import (
+        ell_gather_mv,
+        ell_scatter_mv,
+    )
+
+    v = jnp.ones(n, dtype=val.dtype) / jnp.sqrt(jnp.float32(n))
+
+    def body(_, v):
+        w = ell_scatter_mv(idx, val, ell_gather_mv(idx, val, v), n)
+        return w / (jnp.linalg.norm(w) + 1e-12)
+
+    v = jax.lax.fori_loop(0, iters, body, v)
+    return jnp.sqrt(
+        jnp.linalg.norm(
+            ell_scatter_mv(idx, val, ell_gather_mv(idx, val, v), n)
+        )
+        + 1e-12
+    )
+
+
 #: memoized fused L2 cores per iteration schedule (one jitted program; its
-#: jit cache holds one executable per portfolio bucket shape)
-_L2_FUSED_CORES: dict = {}
+#: jit cache holds one executable per portfolio bucket shape) — LRU-bounded
+#: so schedule sweeps cannot accrete executables (utils/memo)
+_L2_FUSED_CORES: LRU = LRU(cap=4, name="l2_fused_cores")
 
 
 def _get_l2_fused_core(
@@ -175,17 +231,136 @@ def _get_l2_fused_core(
     return fused
 
 
+#: memoized ELL fused cores per schedule (shape-keyed executables inside)
+_L2_FUSED_CORES_ELL: LRU = LRU(cap=4, name="l2_fused_cores_ell")
+
+
+def _get_l2_fused_core_ell(
+    eps_iters: int, check_every: int, chunk: int, max_chunks: int
+):
+    """The fused L2 stage on the ELL rep of the portfolio.
+
+    Same three stages as :func:`_get_l2_fused_core` — min-ε anchor, ε-floor
+    pick, dual ascent under an on-device convergence ``while_loop`` — with
+    every matvec running on the packed ``indices/values`` arrays: the anchor
+    solves the two-sided ε master over the portfolio columns
+    (``lp_pdhg._pdhg_two_sided_body_ell`` — its arithmetic deviation is what
+    the floor pick judges anyway), and the ascent is the ELL gather/scatter
+    pair. The float64 floor/blend arithmetic stays with the caller,
+    unchanged.
+    """
+    key = (int(eps_iters), int(check_every), int(chunk), int(max_chunks))
+    core = _L2_FUSED_CORES_ELL.get(key)
+    if core is not None:
+        return core
+
+    import jax
+    import jax.numpy as jnp
+
+    from citizensassemblies_tpu.solvers.lp_pdhg import _pdhg_two_sided_body_ell
+    from citizensassemblies_tpu.solvers.sparse_ops import (
+        ell_gather_mv,
+        ell_scatter_mv,
+    )
+
+    eps_iters, check_every, chunk, max_chunks = key
+
+    @jax.jit
+    def fused(idx, val, t, p_don, eps_margin, eps_tol, ascent_tol):
+        f32 = val.dtype
+        C = idx.shape[0]
+        n = t.shape[0]
+        # --- stage 1: min-ε anchor — the two-sided ε master over the
+        # portfolio columns, on the packed rep ------------------------------
+        x, _lam, _mu, it_eps, _res = _pdhg_two_sided_body_ell(
+            idx, val, t, jnp.ones(C, f32),
+            jnp.zeros(C + 1, f32), jnp.zeros(2 * n, f32), jnp.zeros((), f32),
+            eps_tol, max_iters=eps_iters, check_every=check_every,
+        )
+        q = jnp.clip(x[:C], 0.0, 1.0)
+        s = q.sum()
+        q_n = jnp.where(s > 0, q / jnp.maximum(s, 1e-30), p_don)
+        # --- stage 2: ε-floor pick, donor vs anchor, on device ------------
+        dev_q = jnp.abs(ell_scatter_mv(idx, val, q_n, n) - t).max()
+        dev_don = jnp.abs(ell_scatter_mv(idx, val, p_don, n) - t).max()
+        use_q = (s > 0) & (dev_q < dev_don)
+        p_floor = jnp.where(use_q, q_n, p_don)
+        eps = jnp.minimum(jnp.where(s > 0, dev_q, jnp.inf), dev_don) + eps_margin
+        # --- stage 3: dual ascent with on-device convergence check --------
+        sigma_sq = _ell_power_norm(idx, val, n) ** 2
+        lr = 1.0 / jnp.maximum(sigma_sq / 2.0, 1.0)
+
+        def p_of(lam):
+            return project_simplex(
+                ell_gather_mv(idx, val, lam[:n] - lam[n:]) / 2.0
+            )
+
+        def ascent_iter(lam, _):
+            p = p_of(lam)
+            alloc = ell_scatter_mv(idx, val, p, n)
+            resid_lo = (t - eps) - alloc
+            resid_up = alloc - (t + eps)
+            return (
+                jnp.maximum(
+                    lam + lr * jnp.concatenate([resid_lo, resid_up]), 0.0
+                ),
+                None,
+            )
+
+        def block(carry):
+            lam, p_prev, k, _delta = carry
+            lam, _ = jax.lax.scan(ascent_iter, lam, None, length=chunk)
+            p_new = p_of(lam)
+            delta = jnp.abs(p_new - p_prev).max()
+            return lam, p_new, k + 1, delta
+
+        def cond(carry):
+            _lam, _p, k, delta = carry
+            return (delta > ascent_tol) & (k < max_chunks)
+
+        lam0 = jnp.zeros(2 * n, f32)
+        p0 = p_of(lam0)
+        lam, p, k, _delta = jax.lax.while_loop(
+            cond, block, (lam0, p0, jnp.int32(0), jnp.float32(jnp.inf))
+        )
+        return p, p_floor, it_eps, k * chunk
+
+    _L2_FUSED_CORES_ELL[key] = fused
+    return fused
+
+
 # --- graftcheck-IR registrations (lint/ir.py) -------------------------------
+
+
+# the dense/ELL pairs register at the SAME (C, n) shape — n = 64 with k_pad
+# = 8 slots is the production-representative fill (panels of k members out
+# of n agents) the budget-diff's dense→sparse delta is measured at
 
 
 @register_ir_core("qp.l2_dual_ascent")
 def _ir_dual_ascent() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32 = jnp.float32
-    C, n = 96, 24
+    C, n = 96, 64
     return IRCase(
         fn=_min_norm_dual_ascent,
         args=(S((C, n), f32), S((n,), f32), S((), f32), S((), f32), S((2 * n,), f32)),
+        static=dict(iters=2048),
+        donate_expected=1,  # lam0
+    )
+
+
+@register_ir_core("qp.l2_dual_ascent_ell", dense_ref="qp.l2_dual_ascent")
+def _ir_dual_ascent_ell() -> IRCase:
+    S = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+    C, n, kp = 96, 64, 8
+    return IRCase(
+        fn=_min_norm_dual_ascent_ell,
+        args=(
+            S((C, kp), i32), S((C, kp), f32), S((n,), f32),
+            S((), f32), S((), f32), S((2 * n,), f32),
+        ),
         static=dict(iters=2048),
         donate_expected=1,  # lam0
     )
@@ -195,11 +370,25 @@ def _ir_dual_ascent() -> IRCase:
 def _ir_l2_fused() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32 = jnp.float32
-    C, n = 96, 24
+    C, n = 96, 64
     return IRCase(
         fn=_get_l2_fused_core(1024, 128, 256, 8),
         args=(
             S((C, n), f32), S((n,), f32), S((C,), f32),
+            S((), f32), S((), f32), S((), f32),
+        ),
+    )
+
+
+@register_ir_core("qp.l2_fused_core_ell", dense_ref="qp.l2_fused_core")
+def _ir_l2_fused_ell() -> IRCase:
+    S = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+    C, n, kp = 96, 64, 8
+    return IRCase(
+        fn=_get_l2_fused_core_ell(1024, 128, 256, 8),
+        args=(
+            S((C, kp), i32), S((C, kp), f32), S((n,), f32), S((C,), f32),
             S((), f32), S((), f32), S((), f32),
         ),
     )
@@ -268,6 +457,24 @@ def solve_final_primal_l2(
     PT = P.T.astype(np.float64)
     tgt = np.asarray(target, dtype=np.float64)
     fused_p: Optional[np.ndarray] = None
+    # --- structured-sparse routing (solvers/sparse_ops): the portfolio's
+    # rows are panels — exactly k member columns of n agents — so at XMIN
+    # scale the dense ascent/anchor matvecs are ≥90 % multiply-by-zero.
+    # The pack happens ONCE per call (timed as sparse_pack; the measured
+    # fill and the hit/miss decision land in the run's counters), and the
+    # float64 floor/blend arithmetic below never changes.
+    from citizensassemblies_tpu.solvers.sparse_ops import EllPack, sparse_enabled
+
+    Pnp = np.asarray(P)
+    p_fill = float(np.count_nonzero(Pnp)) / max(Pnp.size, 1)
+    ell = None
+    if sparse_enabled(cfg, p_fill):
+        with log.timer("sparse_pack"):
+            ell = EllPack.from_rows(Pnp.astype(np.float32))
+        log.gauge("sparse_fill_pct", int(round(100 * ell.fill)))
+        log.count("sparse_hit")
+    else:
+        log.count("sparse_miss")
     if floor_donor is not None:
         p_don = np.zeros(P.shape[0], dtype=np.float64)
         p_don[: len(floor_donor)] = np.asarray(floor_donor, dtype=np.float64)
@@ -297,21 +504,33 @@ def solve_final_primal_l2(
 
                 chunk = 512
                 max_chunks = max(1, -(-int(iters) // chunk))
-                core = _get_l2_fused_core(
-                    12_288, int(getattr(cfg, "pdhg_check_every", 128) or 128),
-                    chunk, max_chunks,
-                )
+                check_every = int(getattr(cfg, "pdhg_check_every", 128) or 128)
                 with log.timer("l2_fused"):
-                    Pj = jnp.asarray(P, jnp.float32)
                     tj = jnp.asarray(target, jnp.float32)
                     dj = jnp.asarray(p_don, jnp.float32)
                     margin_dev = jnp.asarray(eps_margin, jnp.float32)
                     eps_tol_dev = jnp.asarray(1e-5, jnp.float32)
                     asc_tol_dev = jnp.asarray(1e-7, jnp.float32)
-                    with no_implicit_transfers(cfg):
-                        p_dev, pf_dev, _it_eps, _it_asc = core(
-                            Pj, tj, dj, margin_dev, eps_tol_dev, asc_tol_dev
+                    if ell is not None:
+                        fused_ell = _get_l2_fused_core_ell(
+                            12_288, check_every, chunk, max_chunks
                         )
+                        idx_j = jnp.asarray(ell.idx)
+                        val_j = jnp.asarray(ell.val)
+                        with no_implicit_transfers(cfg):
+                            p_dev, pf_dev, _it_eps, _it_asc = fused_ell(
+                                idx_j, val_j, tj, dj,
+                                margin_dev, eps_tol_dev, asc_tol_dev,
+                            )
+                    else:
+                        fused_dense = _get_l2_fused_core(
+                            12_288, check_every, chunk, max_chunks
+                        )
+                        Pj = jnp.asarray(P, jnp.float32)
+                        with no_implicit_transfers(cfg):
+                            p_dev, pf_dev, _it_eps, _it_asc = fused_dense(
+                                Pj, tj, dj, margin_dev, eps_tol_dev, asc_tol_dev
+                            )
                     # host materialization inside the timer (see bench.py:
                     # block_until_ready alone does not drain a TPU tunnel)
                     fused_p = np.asarray(p_dev, dtype=np.float64)
@@ -346,29 +565,45 @@ def solve_final_primal_l2(
         # float64 validation/blend below remains
         p = fused_p
     else:
-        Pj = jnp.asarray(P, dtype=jnp.float32)
         tj = jnp.asarray(target, dtype=jnp.float32)
         # dual-gradient Lipschitz constant = σ_max(P)²/2, estimated by power
         # iteration (shared with the PDHG core): the closed-form bound
         # max_row_sum · max_col_sum / 2 overestimates σ² by orders of magnitude
         # on expanded portfolios (thousands of panels all containing the popular
         # agents), making the ascent step so small the spread never moved
-        from citizensassemblies_tpu.solvers.lp_pdhg import _power_norm
+        if ell is not None:
+            idx_j = jnp.asarray(ell.idx)
+            val_j = jnp.asarray(ell.val)
+            sigma_sq = float(_ell_power_norm(idx_j, val_j, int(tj.shape[0]))) ** 2
+        else:
+            from citizensassemblies_tpu.solvers.lp_pdhg import _power_norm
 
-        sigma_sq = float(_power_norm(Pj)) ** 2
+            Pj = jnp.asarray(P, dtype=jnp.float32)
+            sigma_sq = float(_power_norm(Pj)) ** 2
         L = max(sigma_sq / 2.0, 1.0)
         with log.timer("l2_dual_ascent"):
-            lam0 = jnp.zeros((2 * Pj.shape[1],), dtype=Pj.dtype)
             # the jitted ascent runs under the no-implicit-transfer guard: every
             # operand is materialized to a device array BEFORE the scope (the
             # scalar conversions too — an eager convert_element_type on a python
-            # float inside the guard counts as an implicit upload, utils/guards)
+            # float inside the guard counts as an implicit upload, utils/guards).
+            # Each branch materializes its OWN lam0 carry: the buffer is
+            # donated to whichever ascent runs.
             from citizensassemblies_tpu.utils.guards import no_implicit_transfers
 
             eps_dev = jnp.asarray(eps, jnp.float32)
             step_dev = jnp.asarray(1.0 / L, jnp.float32)
-            with no_implicit_transfers(cfg):
-                p, _lam = _min_norm_dual_ascent(Pj, tj, eps_dev, step_dev, lam0, iters)
+            if ell is not None:
+                lam0_ell = jnp.zeros((2 * tj.shape[0],), dtype=jnp.float32)
+                with no_implicit_transfers(cfg):
+                    p, _lam = _min_norm_dual_ascent_ell(
+                        idx_j, val_j, tj, eps_dev, step_dev, lam0_ell, iters
+                    )
+            else:
+                lam0 = jnp.zeros((2 * tj.shape[0],), dtype=jnp.float32)
+                with no_implicit_transfers(cfg):
+                    p, _lam = _min_norm_dual_ascent(
+                        Pj, tj, eps_dev, step_dev, lam0, iters
+                    )
             # host materialization inside the timer: through a TPU tunnel,
             # block_until_ready alone does not drain the pipeline (see bench.py)
             p = np.asarray(p, dtype=np.float64)

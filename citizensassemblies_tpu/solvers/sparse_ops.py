@@ -1,0 +1,289 @@
+"""Structured-sparse (fixed-nnz ELL) operators for the PDHG/QP cores.
+
+Every LP/QP matrix this framework ships to the device has columns (or rows)
+that are panel *compositions*: at most ``k`` nonzeros out of ``T`` types
+(k ≈ 20–40 against T up to 600+ on the household quotient), yet the dense
+cores do full GEMVs — ≥90 % of the MXU FLOPs and HBM bytes per PDHG
+iteration are multiply-by-zero. A PDLP-style first-order method lives and
+dies on matvec cost (Applegate et al. 2021), so the fix is representational:
+
+* **ELL layout** — a ``[major, minor]`` matrix with at most ``k_pad``
+  nonzeros per major row is stored as ``indices[major, k_pad]`` (int32
+  minor positions) and ``values[major, k_pad]`` (float32), padding slots
+  pointing at minor 0 with value 0.0 — *inert by construction* for both
+  matvec directions (a zero value contributes nothing to a gather sum and
+  scatters nothing into a segment sum), so no mask tensor rides along.
+* **two jitted matvecs** — the gather direction ``(M x)[j] = Σ_s
+  values[j,s] · x[indices[j,s]]`` and the scatter/transpose direction
+  ``(Mᵀ y)[i] = Σ_{j,s: indices[j,s]=i} values[j,s] · y[j]``
+  (``segment_sum``). Batched variants are plain ``vmap``s with the packed
+  arrays broadcast, which is how the bucketed engine reuses them.
+* **Ruiz on the ELL rep** — row/column ∞-norms come from per-row maxima
+  and ``segment_max`` over the packed values directly; the dense scaled
+  matrix is never materialized.
+* **incremental append** — :class:`EllPack` keeps the packed arrays on the
+  host and re-packs ONLY new major rows as a column-generation portfolio
+  grows (``append``), subsets them by fancy indexing on a prune (``take``),
+  and tracks the measured fill ratio the auto-routing gate
+  (:func:`sparse_enabled`) decides on.
+
+Routing contract: ``Config.sparse_ops`` is a tri-state — ``True`` forces the
+ELL path, ``False`` forces dense, ``None`` (auto) engages ELL exactly when
+the measured fill is ≤ ``Config.sparse_fill_cutoff``. With the knob off
+every call site runs its dense path bit-identically; with it on, results
+differ only by float32 summation order inside the same iteration, and every
+caller keeps its float64 arithmetic acceptance certificate unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from citizensassemblies_tpu.utils.config import Config
+
+#: packed-slot granularity: k_pad rounds up to a multiple of 8 (the f32
+#: sublane tile) so slot growth across CG rounds re-buckets rarely
+_SLOT_ROUND = 8
+
+
+def _round_slots(k: int) -> int:
+    return max(_SLOT_ROUND, -(-int(k) // _SLOT_ROUND) * _SLOT_ROUND)
+
+
+def sparse_enabled(cfg: Optional[Config], fill: float) -> bool:
+    """Resolve the ``Config.sparse_ops`` tri-state for a measured fill.
+
+    ``True``/``False`` force; ``None`` (auto) turns the ELL path on exactly
+    when the measured fill ratio is at or below
+    ``Config.sparse_fill_cutoff`` — the regime where the gather/scatter
+    matvecs beat the dense GEMV on both FLOPs and HBM bytes.
+    """
+    knob = getattr(cfg, "sparse_ops", None)
+    if knob is not None:
+        return bool(knob)
+    cutoff = float(getattr(cfg, "sparse_fill_cutoff", 0.25))
+    return float(fill) <= cutoff
+
+
+def ell_pack_rows(
+    rows: np.ndarray, k_pad: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack the rows of a dense ``[J, minor]`` array into ELL arrays.
+
+    Returns ``(indices int32 [J, k_pad], values float32 [J, k_pad],
+    nnz int64 [J])``. Nonzeros keep their original (ascending-minor) order —
+    a stable argsort on the zero mask, so the pack/unpack round trip is
+    exact. ``k_pad`` defaults to the max row nnz rounded up to a slot
+    multiple; passing a larger one keeps bucket shapes stable across
+    appends. Raises when a row has more nonzeros than ``k_pad``.
+    """
+    rows = np.asarray(rows)
+    J, minor = rows.shape
+    mask = rows != 0
+    nnz = mask.sum(axis=1).astype(np.int64)
+    need = int(nnz.max()) if J else 0
+    kp = _round_slots(max(need, 1)) if k_pad is None else int(k_pad)
+    if need > kp:
+        raise ValueError(f"row nnz {need} exceeds the ELL slot count {kp}")
+    take = min(kp, minor)
+    # stable sort on the zero mask: nonzero positions first, original order
+    order = np.argsort(~mask, axis=1, kind="stable")[:, :take]
+    vals = np.take_along_axis(rows, order, axis=1)
+    slot = np.arange(take)[None, :]
+    keep = slot < nnz[:, None]
+    idx = np.where(keep, order, 0).astype(np.int32)
+    val = np.where(keep, vals, 0.0).astype(np.float32)
+    if take < kp:  # minor smaller than the slot bucket: pad inert slots
+        idx = np.pad(idx, ((0, 0), (0, kp - take)))
+        val = np.pad(val, ((0, 0), (0, kp - take)))
+    return idx, val, nnz
+
+
+def ell_unpack_rows(idx: np.ndarray, val: np.ndarray, minor: int) -> np.ndarray:
+    """Dense ``[J, minor]`` reconstruction of packed rows (tests/fuzz)."""
+    J = idx.shape[0]
+    out = np.zeros((J, minor), dtype=np.float64)
+    rows = np.repeat(np.arange(J), idx.shape[1])
+    np.add.at(out, (rows, idx.ravel()), val.ravel().astype(np.float64))
+    return out
+
+
+@dataclasses.dataclass
+class EllPack:
+    """Host-side ELL pack of a *growing* set of sparse major rows.
+
+    The face-decomposition loop adds a few thousand columns per round and
+    prunes back to the mass-bearing support; re-packing the whole portfolio
+    every round would repeat O(C·T) host work that the incremental contract
+    avoids: :meth:`append` packs only the NEW rows (growing the shared slot
+    count when a new row needs it, which only zero-pads the existing
+    arrays), and :meth:`take` subsets by fancy indexing. ``fill`` is the
+    measured nnz ratio the auto gate routes on, and ``pack_rows`` counts
+    how many rows were ever packed (the bench's pack-overhead counter
+    rides the ``sparse_pack`` timer at the call sites).
+    """
+
+    minor: int
+    idx: np.ndarray = None  # [J, k_pad] int32
+    val: np.ndarray = None  # [J, k_pad] float32
+    nnz_total: int = 0
+    pack_rows: int = 0
+
+    def __post_init__(self):
+        if self.idx is None:
+            self.idx = np.zeros((0, _SLOT_ROUND), dtype=np.int32)
+        if self.val is None:
+            self.val = np.zeros((0, _SLOT_ROUND), dtype=np.float32)
+
+    def __len__(self) -> int:
+        return self.idx.shape[0]
+
+    @property
+    def k_pad(self) -> int:
+        return self.idx.shape[1]
+
+    @property
+    def fill(self) -> float:
+        J = len(self)
+        return (self.nnz_total / (J * self.minor)) if J else 0.0
+
+    @classmethod
+    def from_rows(cls, rows: np.ndarray, minor: Optional[int] = None) -> "EllPack":
+        pack = cls(minor=int(minor if minor is not None else rows.shape[1]))
+        pack.append(rows)
+        return pack
+
+    def append(self, rows: np.ndarray) -> None:
+        """Pack and append new major rows (the incremental-column contract)."""
+        rows = np.asarray(rows)
+        if rows.size == 0:
+            return
+        need = int((rows != 0).sum(axis=1).max())
+        kp = max(self.k_pad, _round_slots(max(need, 1)))
+        if kp > self.k_pad:  # grow the shared slot bucket: zero slots are inert
+            grow = kp - self.k_pad
+            self.idx = np.pad(self.idx, ((0, 0), (0, grow)))
+            self.val = np.pad(self.val, ((0, 0), (0, grow)))
+        idx, val, nnz = ell_pack_rows(rows, k_pad=kp)
+        self.idx = np.concatenate([self.idx, idx], axis=0)
+        self.val = np.concatenate([self.val, val], axis=0)
+        self.nnz_total += int(nnz.sum())
+        self.pack_rows += rows.shape[0]
+
+    def take(self, sel: np.ndarray) -> "EllPack":
+        """Subset (and reorder) the packed rows — a portfolio prune."""
+        sel = np.asarray(sel)
+        idx = self.idx[sel]
+        val = self.val[sel]
+        out = EllPack(minor=self.minor, idx=idx, val=val)
+        out.nnz_total = int((val != 0).sum())
+        out.pack_rows = self.pack_rows
+        return out
+
+    def padded(self, rows: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(idx, val) zero-padded to ``rows`` major rows (bucket padding:
+        all-zero rows are inert for both matvec directions)."""
+        J = len(self)
+        if rows < J:
+            raise ValueError(f"pad target {rows} below packed row count {J}")
+        if rows == J:
+            return self.idx, self.val
+        idx = np.zeros((rows, self.k_pad), dtype=np.int32)
+        val = np.zeros((rows, self.k_pad), dtype=np.float32)
+        idx[:J] = self.idx
+        val[:J] = self.val
+        return idx, val
+
+
+# --- jitted matvec primitives ------------------------------------------------
+# The gather/scatter pair every ELL core in the repo composes. They are
+# deliberately tiny free functions (not methods) so the PDHG/QP cores can
+# inline them inside their own jitted bodies without a pytree wrapper.
+
+
+def ell_gather_mv(idx, val, x):
+    """``(M x)[j] = Σ_s values[j,s] · x[indices[j,s]]`` — the row-gather
+    direction. Traceable; padding slots contribute ``0 · x[0]``."""
+    return (val * x[idx]).sum(axis=1)
+
+
+def ell_scatter_mv(idx, val, y, minor: int):
+    """``(Mᵀ y)[i]`` — the transpose/scatter direction via ``segment_sum``
+    (``minor`` is a static shape at trace time)."""
+    import jax
+
+    contrib = val * y[:, None]
+    return jax.ops.segment_sum(
+        contrib.ravel(), idx.ravel(), num_segments=int(minor)
+    )
+
+
+def ell_row_absmax(idx, val, minor: int):
+    """Per-MINOR max of |values| (``segment_max``, clamped at 0 so minors
+    hit by no slot scale like an all-zero dense row)."""
+    import jax
+    import jax.numpy as jnp
+
+    seg = jax.ops.segment_max(
+        jnp.abs(val).ravel(), idx.ravel(), num_segments=int(minor)
+    )
+    return jnp.maximum(seg, 0.0)
+
+
+def ell_col_absmax(val):
+    """Per-MAJOR max of |values| (one reduction over the slot axis)."""
+    import jax.numpy as jnp
+
+    return jnp.abs(val).max(axis=1)
+
+
+def batched_ell_gather_mv(idx, val, X):
+    """Batched gather matvec: shared pack, ``X [B, minor]`` → ``[B, major]``
+    — the bucketed engine's broadcast form."""
+    import jax
+
+    return jax.vmap(lambda x: ell_gather_mv(idx, val, x))(X)
+
+
+def batched_ell_scatter_mv(idx, val, Y, minor: int):
+    """Batched transpose matvec: shared pack, ``Y [B, major]`` →
+    ``[B, minor]``."""
+    import jax
+
+    return jax.vmap(lambda y: ell_scatter_mv(idx, val, y, minor))(Y)
+
+
+def ell_ruiz_equilibrate(idx, val, minor: int, iters: int = 8):
+    """Ruiz row/column scalings computed directly on the ELL rep.
+
+    For the packed ``[major, minor]`` matrix: returns ``(d_major, d_minor)``
+    with ``d_major[j] · M[j, i] · d_minor[i]`` of ≈ unit row/col ∞-norms —
+    the same 8-sweep sqrt scheme as the dense cores
+    (``lp_pdhg._ruiz_equilibrate``), with the row maxima taken over the slot
+    axis and the column maxima by ``segment_max``; the scaled matrix is
+    never materialized. All-zero rows/columns keep scale 1 (bucket padding).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    major = idx.shape[0]
+    absv = jnp.abs(val)
+
+    def body(_, carry):
+        d_j, d_i = carry
+        S = absv * d_j[:, None] * d_i[idx]
+        jmax = S.max(axis=1)
+        imax = jnp.maximum(
+            jax.ops.segment_max(S.ravel(), idx.ravel(), num_segments=int(minor)),
+            0.0,
+        )
+        jn = jnp.where(jmax > 0, jnp.sqrt(jnp.maximum(jmax, 1e-10)), 1.0)
+        inn = jnp.where(imax > 0, jnp.sqrt(jnp.maximum(imax, 1e-10)), 1.0)
+        return d_j / jn, d_i / inn
+
+    d_j0 = jnp.ones(major, dtype=val.dtype)
+    d_i0 = jnp.ones(int(minor), dtype=val.dtype)
+    return jax.lax.fori_loop(0, iters, body, (d_j0, d_i0))

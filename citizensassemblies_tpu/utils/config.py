@@ -216,6 +216,24 @@ class Config:
     #: is unchanged.
     lp_batch_screen: bool = True
 
+    # --- structured-sparse operator layer (solvers/sparse_ops.py) -------------
+    #: route the PDHG/QP hot cores through the fixed-nnz ELL operator layer
+    #: (``solvers/sparse_ops.py``): the face-decomposition master and polish,
+    #: the batched polish screen, the dual leximin LP, the XMIN L2 stage and
+    #: the mesh-sharded dual LP then run gather/scatter matvecs over packed
+    #: ``indices/values`` arrays instead of dense GEMVs — the matrices'
+    #: columns are panel compositions (≤ k nonzeros of T types), so at
+    #: production shapes ≥90 % of the dense FLOPs/HBM bytes are
+    #: multiply-by-zero. ``None`` = auto (on exactly when the measured fill
+    #: is ≤ ``sparse_fill_cutoff``); ``True``/``False`` force. Off ⇒ every
+    #: call site runs its dense path bit-identically.
+    sparse_ops: Optional[bool] = None
+    #: auto-routing cutoff for ``sparse_ops=None``: the ELL path engages when
+    #: the measured nnz fill ratio of the packed operator is at or below
+    #: this. 0.25 ≈ the break-even where gather/scatter matvec traffic
+    #: (indices + values) stops beating the dense GEMV's bytes.
+    sparse_fill_cutoff: float = 0.25
+
     #: route the agent-space dual LP through the mesh-sharded device PDHG
     #: (``parallel/solver.py``) whenever more than one device is visible and
     #: the portfolio has at least this many rows — the regime where the C×n

@@ -62,6 +62,12 @@ class RunLog:
         :func:`citizensassemblies_tpu.utils.profiling.format_counters`."""
         self._counters[name] = self._counters.get(name, 0) + inc
 
+    def gauge(self, name: str, value) -> None:
+        """Record a point-in-time VALUE (latest wins, no accumulation) into
+        the counters channel — e.g. the measured ELL fill ratio of the last
+        pack, which a bench row wants as a level, not a sum."""
+        self._counters[name] = value
+
     @property
     def counters(self) -> dict:
         return dict(self._counters)

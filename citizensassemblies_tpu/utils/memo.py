@@ -1,0 +1,76 @@
+"""Bounded LRU memo for module-level jit/shard_map caches.
+
+The repo memoizes built callables at module level so repeat calls re-enter
+compiled executables instead of re-lowering (graftlint R2): the chain-parallel
+MC wrappers (``parallel/mc.py``), the batched LP engine's per-schedule cores
+(``solvers/batch_lp.py``), the fused L2 cores (``solvers/qp.py``) and the
+mesh-keyed sharded PDHG programs (``parallel/solver.py``). Plain dicts there
+are unbounded: a long bench session that recreates meshes, or a sweep over
+iteration schedules, accretes executables (and the device buffers their
+constants pin) forever. :class:`LRU` bounds each cache with
+least-recently-used eviction and counts every eviction into one module
+counter, so cache pressure is observable (``memo_evictions()`` — bench
+evidence rows record it).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Iterator, Optional
+
+#: process-wide eviction count across every LRU memo (observability only)
+_EVICTIONS = 0
+
+
+def memo_evictions() -> int:
+    """Total LRU memo evictions since process start, across all caches."""
+    return _EVICTIONS
+
+
+class LRU:
+    """A small ordered cache with least-recently-used eviction.
+
+    Drop-in for the dict operations the memo sites use (``get``, item
+    assignment, ``in``, ``len``, ``clear``, iteration over keys). A hit
+    refreshes recency; an insert beyond ``cap`` evicts the oldest entry and
+    bumps the global eviction counter.
+    """
+
+    def __init__(self, cap: int, name: str = ""):
+        self.cap = max(int(cap), 1)
+        self.name = name
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+        self.evictions = 0
+
+    def get(self, key, default: Optional[Any] = None):
+        try:
+            self._d.move_to_end(key)
+        except KeyError:
+            return default
+        return self._d[key]
+
+    def __getitem__(self, key):
+        self._d.move_to_end(key)
+        return self._d[key]
+
+    def __setitem__(self, key, value) -> None:
+        global _EVICTIONS
+        if key in self._d:
+            self._d.move_to_end(key)
+        self._d[key] = value
+        while len(self._d) > self.cap:
+            self._d.popitem(last=False)
+            self.evictions += 1
+            _EVICTIONS += 1
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __iter__(self) -> Iterator:
+        return iter(list(self._d))
+
+    def clear(self) -> None:
+        self._d.clear()
