@@ -858,7 +858,160 @@ def smoke() -> int:
     return 1 if failures else 0
 
 
+def serve_bench(smoke_mode: bool = False) -> int:
+    """graftserve bench: drive a mixed fleet of whole selection instances
+    through the async service and measure the SERVING metrics — p50/p99
+    request latency, throughput (instances/min), cross-request batch
+    occupancy (solves per engine dispatch), warm-rep compile bound — with
+    every request's allocation checked against its serial single-instance
+    run under the established 1e-3 L∞ contract.
+
+    ``--serve`` runs the full fleet (≥50 mixed-size instances, a new BENCH
+    row family); ``--serve --smoke`` is the CI variant: a dozen tiny
+    mixed-shape requests, with the invariants ASSERTED (cross-request
+    batching occurred, per-request parity vs serial, warm reps
+    compile-clean, tenant memo serves a repeat) and a process exit code.
+    """
+    import numpy as np
+
+    from citizensassemblies_tpu.core.generator import random_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+    from citizensassemblies_tpu.service import SelectionRequest, SelectionService
+    from citizensassemblies_tpu.utils.config import default_config
+    from citizensassemblies_tpu.utils.guards import CompilationGuard, GuardViolation
+    from citizensassemblies_tpu.utils.memo import memo_evictions_by_owner
+
+    t_start = time.time()
+    failures = []
+    bound = int(os.environ.get("BENCH_COMPILE_BOUND", "8"))
+    # the engine is exercised explicitly (CPU CI would auto-route it off);
+    # the window is held slightly open so concurrent fleets actually meet
+    cfg = default_config().replace(
+        lp_batch=True, serve_batch_window_ms=8.0, serve_admission_cap=8,
+    )
+
+    # --- the fleet: mixed-size tenant instances (mass_like_24-class) --------
+    n_requests = 12 if smoke_mode else int(os.environ.get("BENCH_SERVE_N", "60"))
+    specs = []
+    for i in range(n_requests):
+        n = 24 + 8 * (i % (3 if smoke_mode else 8))
+        k = 4 + (i % 4)
+        specs.append(
+            (random_instance(n=n, k=k, n_categories=2, seed=i % 7), f"tenant{i % 3}")
+        )
+
+    # serial references FIRST (also warms every executable the shapes need,
+    # so the serve pass below measures steady-state serving, not compile)
+    refs = []
+    t_serial0 = time.time()
+    for inst, _tenant in specs:
+        d, s = featurize(inst)
+        refs.append(find_distribution_leximin(d, s, cfg=cfg))
+    serial_s = time.time() - t_serial0
+
+    # --- the serve pass ----------------------------------------------------
+    svc = SelectionService(cfg)
+    lat = []
+    t_serve0 = time.time()
+    with CompilationGuard(name="serve_fleet") as serve_guard:
+        chans = []
+        for inst, tenant in specs:
+            t_sub = time.time()
+            chans.append(
+                (t_sub, svc.submit(SelectionRequest(instance=inst, tenant=tenant)))
+            )
+        results = []
+        for t_sub, ch in chans:
+            res = ch.result(timeout=600)
+            lat.append(time.time() - t_sub)
+            results.append(res)
+    serve_s = time.time() - t_serve0
+
+    # --- per-request exactness vs the serial reference ---------------------
+    worst_dev = 0.0
+    for res, ref in zip(results, refs):
+        worst_dev = max(worst_dev, float(np.abs(res.allocation - ref.allocation).max()))
+    if worst_dev > 1e-3:
+        failures.append(f"served allocation deviates {worst_dev:.2e} > 1e-3 vs serial")
+
+    # --- occupancy: cross-request solves per engine dispatch ---------------
+    bstats = svc.batcher.stats()
+    occupancy = bstats["solves"] / max(bstats["dispatches"], 1)
+    if bstats["fused_dispatches"] < 1:
+        failures.append("no dispatch fused fleets from ≥2 requests (no cross-request batching)")
+    if occupancy <= 1.0 and bstats["dispatches"] > 0:
+        failures.append(f"cross-request occupancy {occupancy:.2f} ≤ 1 solve/dispatch")
+
+    # --- warm reps: repeat a slice of the fleet; executables must be hot,
+    # and an identical re-submission must be served from the tenant memo ----
+    warm_ok = True
+    warm_res = []
+    try:
+        # GuardViolation raises at scope EXIT, so the try wraps the with
+        with CompilationGuard(name="serve_warm", max_compiles=bound) as warm_guard:
+            # the LAST slice of the fleet: still resident in each tenant's
+            # LRU memo (the earliest requests may have been evicted — which
+            # the memo_evictions_by_owner field then attributes per tenant)
+            warm_res = [
+                svc.run(SelectionRequest(instance=inst, tenant=tenant), timeout=600)
+                for inst, tenant in specs[-4:]
+            ]
+    except GuardViolation:
+        warm_ok = False
+        failures.append(
+            f"warm serve reps compiled {warm_guard.count}x > bound {bound}"
+        )
+    memo_hits = sum(1 for r in warm_res if r.from_memo)
+    if warm_ok and memo_hits == 0:
+        failures.append("identical re-submission was not served from the tenant memo")
+    svc.shutdown()
+
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    host_syncs = sum(int(r.audit.get("decomp_host_syncs", 0)) for r in results)
+    row = {
+        "metric": "graftserve_mixed_fleet",
+        "value": round(serve_s, 2),
+        "unit": "s",
+        "detail": {
+            "requests": n_requests,
+            "p50_latency_s": round(p50, 3),
+            "p99_latency_s": round(p99, 3),
+            "throughput_inst_per_min": round(60.0 * n_requests / max(serve_s, 1e-9), 1),
+            "serial_reference_s": round(serial_s, 2),
+            "speedup_vs_serial": round(serial_s / max(serve_s, 1e-9), 2),
+            "worst_alloc_linf_dev": round(worst_dev, 9),
+            "cross_request_batcher": bstats,
+            "solves_per_dispatch": round(occupancy, 2),
+            "decomp_host_syncs_total": host_syncs,
+            "xla_compiles_serve": serve_guard.count,
+            "xla_compiles_warm": warm_guard.count,
+            "warm_memo_hits": memo_hits,
+            "tenants": svc.tenants.all_stats(),
+            "memo_evictions_by_owner": memo_evictions_by_owner(),
+            "failures": failures,
+        },
+    }
+    if smoke_mode:
+        row = {
+            "serve_smoke_ok": not failures,
+            "seconds": round(time.time() - t_start, 1),
+            "p50_latency_s": round(p50, 3),
+            "solves_per_dispatch": round(occupancy, 2),
+            "fused_dispatches": bstats["fused_dispatches"],
+            "worst_alloc_linf_dev": round(worst_dev, 9),
+            "warm_compiles": warm_guard.count,
+            "failures": failures,
+        }
+    print(json.dumps(row))
+    return 1 if failures else 0
+
+
 if __name__ == "__main__":
+    if "--serve" in sys.argv:
+        raise SystemExit(serve_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         raise SystemExit(smoke())
     main()

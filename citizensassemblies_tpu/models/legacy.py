@@ -296,6 +296,7 @@ def legacy_probabilities(
     cfg: Optional[Config] = None,
     households: Optional[np.ndarray] = None,
     distribute: Optional[bool] = None,
+    ctx=None,
 ) -> LegacyResult:
     """Estimate the LEGACY probability allocation from ``iterations`` draws
     (the Monte-Carlo estimator of ``analysis.py:162-191``).
@@ -306,13 +307,21 @@ def legacy_probabilities(
 
     ``distribute=None`` auto-shards the draws over every visible device
     (bit-identical to the single-device path — chain randomness is keyed on
-    global chain ids); pass False/True to force either path.
+    global chain ids); pass False/True to force either path. ``ctx`` (a
+    ``service.RequestContext``) supplies the per-request cfg and scopes the
+    estimator for the serving layer (re-entrancy contract).
     """
-    cfg = cfg or default_config()
-    panels, draws = sample_feasible_panels(
-        dense, iterations, seed=seed, cfg=cfg, households=households,
-        distribute=distribute,
+    from citizensassemblies_tpu.service.context import (
+        resolve as resolve_context,
+        use_context,
     )
+
+    ctx, cfg, _log = resolve_context(ctx, cfg, None)
+    with use_context(ctx):
+        panels, draws = sample_feasible_panels(
+            dense, iterations, seed=seed, cfg=cfg, households=households,
+            distribute=distribute,
+        )
     n = dense.n
     denom = max(iterations, 1)
     counts = np.bincount(panels.ravel(), minlength=n)

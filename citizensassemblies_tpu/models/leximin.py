@@ -57,7 +57,11 @@ from citizensassemblies_tpu.utils.checkpoint import (
     problem_fingerprint,
     save_cg_state,
 )
-from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.service.context import (
+    resolve as resolve_context,
+    use_context,
+)
+from citizensassemblies_tpu.utils.config import Config
 from citizensassemblies_tpu.utils.logging import RunLog
 from citizensassemblies_tpu.utils.profiling import format_counters, format_timers
 
@@ -402,6 +406,7 @@ def find_distribution_leximin(
     initial_panels: Optional[List[Tuple[int, ...]]] = None,
     final_stage: str = "lp",
     checkpoint_path: Optional[str] = None,
+    ctx=None,
 ) -> Distribution:
     """Compute the exact LEXIMIN distribution over feasible committees.
 
@@ -414,9 +419,29 @@ def find_distribution_leximin(
     there after every fixed tranche and restored on restart, so a preempted
     long run resumes instead of recomputing from zero (SURVEY §5 — capability
     the reference lacks). The file is removed on successful completion.
+    ``ctx`` (a ``service.RequestContext``) supplies per-request cfg/log and
+    is installed as the ambient context for the solve — the serving layer's
+    re-entrancy contract: everything this call mutates (counters, warm
+    slots, knobs) is reached through it, never through process globals.
     """
-    cfg = cfg or default_config()
-    log = log or RunLog(echo=False)
+    ctx, cfg, log = resolve_context(ctx, cfg, log)
+    with use_context(ctx):
+        return _leximin_impl(
+            dense, space, cfg, households, log, initial_panels, final_stage,
+            checkpoint_path,
+        )
+
+
+def _leximin_impl(
+    dense: DenseInstance,
+    space: Optional[FeatureSpace],
+    cfg: Config,
+    households: Optional[np.ndarray],
+    log: RunLog,
+    initial_panels: Optional[List[Tuple[int, ...]]],
+    final_stage: str,
+    checkpoint_path: Optional[str],
+) -> Distribution:
     log.emit("Using leximin algorithm.")
     n = dense.n
 

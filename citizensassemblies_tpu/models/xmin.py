@@ -33,8 +33,12 @@ import numpy as np
 from citizensassemblies_tpu.core.instance import DenseInstance, FeatureSpace
 from citizensassemblies_tpu.models.legacy import sample_panels_batch
 from citizensassemblies_tpu.models.leximin import Distribution, find_distribution_leximin
+from citizensassemblies_tpu.service.context import (
+    resolve as resolve_context,
+    use_context,
+)
 from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
-from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.config import Config
 from citizensassemblies_tpu.utils.logging import RunLog
 
 
@@ -45,17 +49,31 @@ def find_distribution_xmin(
     households: Optional[np.ndarray] = None,
     log: Optional[RunLog] = None,
     leximin: Optional[Distribution] = None,
+    ctx=None,
 ) -> Distribution:
     """Compute the XMIN distribution: leximin-optimal per-agent probabilities
     over an expanded, support-maximized portfolio.
 
     ``leximin`` optionally supplies a precomputed LEXIMIN distribution for
     the same (dense, cfg, households) problem, skipping step 1 — callers
-    that already hold one (the analysis cache, benchmarks) avoid a duplicate
-    full solve."""
-    cfg = cfg or default_config()
-    log = log or RunLog(echo=False)
+    that already hold one (the analysis cache, benchmarks, the service's
+    tenant-session memo) avoid a duplicate full solve. ``ctx`` (a
+    ``service.RequestContext``) supplies per-request cfg/log and is
+    installed as the ambient context for the solve (re-entrancy contract —
+    see ``find_distribution_leximin``)."""
+    ctx, cfg, log = resolve_context(ctx, cfg, log)
+    with use_context(ctx):
+        return _xmin_impl(dense, space, cfg, households, log, leximin)
 
+
+def _xmin_impl(
+    dense: DenseInstance,
+    space: Optional[FeatureSpace],
+    cfg: Config,
+    households: Optional[np.ndarray],
+    log: RunLog,
+    leximin: Optional[Distribution],
+) -> Distribution:
     # 1) exact leximin (fixes every agent's probability; xmin.py:506-508)
     if leximin is None:
         leximin = find_distribution_leximin(

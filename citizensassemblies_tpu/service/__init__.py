@@ -1,0 +1,49 @@
+"""graftserve: persistent async selection-as-a-service layer.
+
+Public surface::
+
+    from citizensassemblies_tpu.service import (
+        SelectionService, SelectionRequest, RequestContext,
+    )
+
+    with SelectionService(cfg) as svc:
+        ch = svc.submit(SelectionRequest(instance=inst, algorithm="leximin",
+                                         tenant="city-a"))
+        for kind, payload in ch.events():
+            ...                      # ("progress", line) stream
+        res = ch.result()            # RequestResult: allocation + audit stamp
+
+See ``service/server.py`` for the request lifecycle, ``service/batcher.py``
+for the cross-request shape-bucketed batching, ``service/session.py`` for
+per-tenant state, and ``service/context.py`` for the per-request re-entrancy
+contract the solver stack now honors.
+"""
+
+from citizensassemblies_tpu.service.batcher import CrossRequestBatcher
+from citizensassemblies_tpu.service.context import (
+    RequestContext,
+    current_context,
+    use_context,
+)
+from citizensassemblies_tpu.service.server import (
+    AdmissionError,
+    RequestResult,
+    ResultChannel,
+    SelectionRequest,
+    SelectionService,
+)
+from citizensassemblies_tpu.service.session import TenantRegistry, TenantSession
+
+__all__ = [
+    "AdmissionError",
+    "CrossRequestBatcher",
+    "RequestContext",
+    "RequestResult",
+    "ResultChannel",
+    "SelectionRequest",
+    "SelectionService",
+    "TenantRegistry",
+    "TenantSession",
+    "current_context",
+    "use_context",
+]

@@ -1,0 +1,216 @@
+"""Cross-request shape-bucketed batching: fuse LP fleets ACROSS requests.
+
+The batched LP engine (``solvers/batch_lp``) fuses the many small solves of
+ONE selection job into padded vmapped dispatches — but a serving workload is
+a fleet of whole jobs, each of whose LP fleets is small (a mass_like_24-sized
+tenant instance prescreens a handful of probe LPs per stage). Each job alone
+still pays the dispatch floor per call. This module is the serving stack's
+continuous-batching layer on top of the engine: when concurrent requests'
+worker threads reach ``solve_lp_batch``, their fleets are briefly held open
+(``Config.serve_batch_window_ms``) and merged — same iteration schedule, any
+mix of shapes (the engine's shape buckets then group the union) — into ONE
+engine call, so a probe fleet from tenant A and one from tenant B land in the
+same padded dispatch.
+
+Correctness invariants:
+
+* **per-instance math unchanged** — merging only concatenates instance
+  lists; each instance keeps its own tolerance (materialized into
+  ``BatchLP.tol`` before the merge) and gets its own convergence mask lane,
+  exactly as within-request batching already guaranteed;
+* **schedule compatibility** — fleets merge only within a group key of
+  (max_iters, check_every, bucket cap, transfer-guard mode), the knobs that
+  select/parameterize the compiled core, so no request executes under
+  another's schedule;
+* **warm-slot isolation** — each submission's warm slots are loaded from and
+  written back to its OWN request's store under its tenant/request-scoped
+  key before/after the merge; positions inside the merged list never touch
+  the slot keys;
+* **no deadlock** — the first submitter of a group becomes its leader,
+  sleeps out the window (GIL released), then dispatches whatever joined;
+  followers wait on an event with a timeout fallback that re-claims their
+  fleet and solves it directly if the leader ever died.
+
+The batcher owns no threads — it runs entirely on the submitting requests'
+worker threads — and holds no jax state; it is pure host-side coordination.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from citizensassemblies_tpu.utils.config import Config, default_config
+
+#: follower safety net: if the leader vanished (worker killed mid-dispatch),
+#: a follower re-claims its fleet after this long and solves directly
+_FOLLOWER_TIMEOUT_S = 120.0
+
+
+class _Pending:
+    """One request's deferred fleet, parked until the group dispatches."""
+
+    def __init__(self, problems, ctx, warm_key: Optional[str], log):
+        self.problems = list(problems)
+        self.ctx = ctx
+        self.warm_key = warm_key
+        self.log = log
+        self.event = threading.Event()
+        self.results: Optional[list] = None
+        self.error: Optional[BaseException] = None
+
+
+class CrossRequestBatcher:
+    """Merge compatible ``solve_lp_batch`` fleets from concurrent requests."""
+
+    def __init__(self, cfg: Optional[Config] = None):
+        cfg = cfg or default_config()
+        #: how long the group leader holds the window open for other
+        #: requests' fleets to join (Config.serve_batch_window_ms)
+        self.window_s = max(float(cfg.serve_batch_window_ms), 0.0) / 1000.0
+        self._lock = threading.Lock()
+        self._groups: Dict[tuple, List[_Pending]] = {}
+        self._leaders: Set[tuple] = set()
+        # --- occupancy accounting (read by the bench's BENCH row) ----------
+        self._stats = {
+            "submissions": 0,          # solve_lp_batch calls deferred here
+            "dispatches": 0,           # merged engine calls made
+            "fused_dispatches": 0,     # … that merged ≥2 distinct requests
+            "solves": 0,               # real LP instances solved
+            "max_requests_fused": 0,   # largest request count in one merge
+        }
+
+    # --- public API ---------------------------------------------------------
+
+    def submit(
+        self,
+        problems: Sequence,
+        ctx,
+        cfg: Optional[Config] = None,
+        log=None,
+        warm_key: Optional[str] = None,
+        tol: Optional[float] = None,
+        max_iters: Optional[int] = None,
+    ) -> list:
+        """Solve ``problems`` through the cross-request window; returns the
+        per-instance solutions in input order (the ``solve_lp_batch``
+        contract — call sites cannot tell they were fused)."""
+        cfg = cfg or default_config()
+        # materialize each instance's effective tolerance NOW: after the
+        # merge there is no per-submission tol argument anymore
+        base_tol = float(tol if tol is not None else cfg.pdhg_tol)
+        problems = [
+            p if p.tol is not None else dataclasses.replace(p, tol=base_tol)
+            for p in problems
+        ]
+        key = (
+            int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
+            int(cfg.pdhg_check_every),
+            int(cfg.lp_batch_bucket_max),
+            str(cfg.transfer_guard),
+        )
+        pend = _Pending(problems, ctx, warm_key, log)
+        with self._lock:
+            self._stats["submissions"] += 1
+            self._groups.setdefault(key, []).append(pend)
+            lead = key not in self._leaders
+            if lead:
+                self._leaders.add(key)
+        if lead:
+            if self.window_s > 0:
+                time.sleep(self.window_s)  # GIL released; followers join
+            with self._lock:
+                batch = self._groups.pop(key, [])
+                self._leaders.discard(key)
+            self._dispatch(key, batch, cfg)
+        else:
+            if not pend.event.wait(timeout=_FOLLOWER_TIMEOUT_S):
+                # leader died without dispatching us: re-claim and solve solo
+                with self._lock:
+                    group = self._groups.get(key, [])
+                    mine = pend in group
+                    if mine:
+                        group.remove(pend)
+                if mine:
+                    self._dispatch(key, [pend], cfg)
+                else:
+                    pend.event.wait()  # dispatch in flight — finish it
+        if pend.error is not None:
+            raise pend.error
+        return pend.results
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    # --- dispatch -----------------------------------------------------------
+
+    def _dispatch(self, key: tuple, batch: List[_Pending], cfg: Config) -> None:
+        """Run the merged fleet through the engine and fan results back."""
+        from citizensassemblies_tpu.solvers.batch_lp import (
+            _DEFAULT_WARM_STORE,
+            solve_lp_batch,
+        )
+
+        if not batch:
+            return
+        max_iters, _check, _cap, _tg = key
+        try:
+            merged = []
+            spans: List[Tuple[int, int]] = []
+            for pend in batch:
+                start = len(merged)
+                store = scoped = None
+                if pend.warm_key is not None and pend.ctx is not None:
+                    store = pend.ctx.warm_store or _DEFAULT_WARM_STORE
+                    scoped = pend.ctx.scoped_warm_key(pend.warm_key)
+                probs = []
+                for i, inst in enumerate(pend.problems):
+                    if inst.warm is None and store is not None:
+                        slot = store.get((scoped, i))
+                        if slot is not None:
+                            inst = dataclasses.replace(inst, warm=slot[:3])
+                    probs.append(inst)
+                merged.extend(probs)
+                spans.append((start, len(merged)))
+            sols = solve_lp_batch(
+                merged, cfg=cfg, log=None, warm_key=None,
+                max_iters=max_iters, defer=False,
+            )
+            n_requests = len({
+                (p.ctx.tenant, p.ctx.request_id)
+                for p in batch if p.ctx is not None
+            })
+            with self._lock:
+                self._stats["dispatches"] += 1
+                self._stats["solves"] += len(merged)
+                if n_requests > 1:
+                    self._stats["fused_dispatches"] += 1
+                self._stats["max_requests_fused"] = max(
+                    self._stats["max_requests_fused"], n_requests
+                )
+            for pend, (start, end) in zip(batch, spans):
+                out = sols[start:end]
+                if pend.warm_key is not None and pend.ctx is not None:
+                    store = pend.ctx.warm_store or _DEFAULT_WARM_STORE
+                    scoped = pend.ctx.scoped_warm_key(pend.warm_key)
+                    for i, (inst, sol) in enumerate(zip(pend.problems, out)):
+                        store.put(
+                            (scoped, i),
+                            (sol.x, sol.lam, sol.mu, int(inst.tail_vars)),
+                        )
+                if pend.log is not None:
+                    pend.log.count("lp_batch_solves", len(out))
+                    pend.log.count("lp_batch_xreq_dispatches")
+                    if n_requests > 1:
+                        pend.log.count("lp_batch_xreq_fused")
+                pend.results = out
+                pend.event.set()
+        except BaseException as exc:
+            for pend in batch:
+                if pend.results is None:
+                    pend.error = exc
+                    pend.event.set()
+            raise

@@ -1,0 +1,143 @@
+"""Per-request execution context: the re-entrancy spine of the service layer.
+
+Before the serving layer existed, one process ran one selection job: a
+``Config`` and a ``RunLog`` were passed down the call stack, and the few
+pieces of cross-call state — the batched LP engine's warm-start slots, the
+memo caches — lived at module level keyed by *semantic* names
+(``"decomp_polish_screen"``). That is exactly the shape that breaks under
+concurrent requests: two jobs in flight share counters, warm iterates and
+knobs through those process-global names.
+
+:class:`RequestContext` lifts all of it to per-request scope. It bundles the
+request's ``Config`` and ``RunLog`` with its identity (tenant + request id),
+its warm-slot store, its tenant session (packed-operand and result memos) and
+the cross-request batcher, and is made AMBIENT for the duration of the
+request via a ``contextvars.ContextVar`` — per-thread/per-task by
+construction, so two requests on two worker threads each see only their own
+context. Deep call sites that cannot reasonably grow a new parameter (the
+batched LP engine's warm-slot keying, the fused L2 stage's pack memo) consult
+:func:`current_context`; the model entry points additionally accept ``ctx``
+explicitly and install it with :func:`use_context`.
+
+Nothing here imports jax — the context layer must stay importable from the
+lint tooling and from host-only code paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import TYPE_CHECKING, Optional
+
+from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from citizensassemblies_tpu.service.batcher import CrossRequestBatcher
+    from citizensassemblies_tpu.service.session import TenantSession
+    from citizensassemblies_tpu.solvers.batch_lp import WarmSlotStore
+
+#: the ambient per-request context — ContextVar semantics give each thread
+#: (and each asyncio task) its own slot, which IS the isolation contract
+_ACTIVE: ContextVar[Optional["RequestContext"]] = ContextVar(
+    "citizens_tpu_request_context", default=None
+)
+
+_REQUEST_SEQ_LOCK = threading.Lock()
+_REQUEST_SEQ = 0
+
+
+def _next_request_id() -> str:
+    """Process-unique fallback id for contexts created without one."""
+    global _REQUEST_SEQ
+    with _REQUEST_SEQ_LOCK:
+        _REQUEST_SEQ += 1
+        return f"req-{_REQUEST_SEQ:06d}"
+
+
+@dataclasses.dataclass
+class RequestContext:
+    """Everything one selection request owns, threaded through the solvers.
+
+    ``cfg``/``log`` are the knobs and the in-band log channel that used to be
+    the loose (cfg, log) parameter pair. ``tenant``/``request_id`` identify
+    the request for warm-slot namespacing and eviction attribution.
+    ``warm_store`` is the request's PRIVATE warm-start slot store for the
+    batched LP engine (``solvers/batch_lp.WarmSlotStore``) — module-level
+    slots are never touched while a context is active. ``session`` is the
+    tenant's cross-request state (result memos, packed ELL operands), LRU-
+    capped with per-tenant eviction accounting. ``batcher`` is the service's
+    cross-request shape-bucketed batcher; when present, batchable LP fleets
+    are routed through it so fleets from DIFFERENT concurrent requests fuse
+    into one padded vmapped dispatch.
+    """
+
+    cfg: Config
+    log: RunLog
+    request_id: str
+    tenant: str = "default"
+    warm_store: Optional["WarmSlotStore"] = None
+    session: Optional["TenantSession"] = None
+    batcher: Optional["CrossRequestBatcher"] = None
+
+    @classmethod
+    def create(
+        cls,
+        cfg: Optional[Config] = None,
+        log: Optional[RunLog] = None,
+        request_id: Optional[str] = None,
+        tenant: str = "default",
+        **kw,
+    ) -> "RequestContext":
+        return cls(
+            cfg=cfg or default_config(),
+            log=log or RunLog(echo=False),
+            request_id=request_id or _next_request_id(),
+            tenant=tenant,
+            **kw,
+        )
+
+    def scoped_warm_key(self, base: str) -> str:
+        """Namespace a semantic warm-slot key (``"decomp_polish_screen"``)
+        by this request's identity, so two concurrent requests using the
+        same call site cannot share (or clobber) warm iterates."""
+        return f"{self.tenant}/{self.request_id}/{base}"
+
+
+def current_context() -> Optional[RequestContext]:
+    """The ambient RequestContext of the calling thread/task, or None when
+    running outside the service (the offline single-job path)."""
+    return _ACTIVE.get()
+
+
+@contextmanager
+def use_context(ctx: Optional[RequestContext]):
+    """Install ``ctx`` as the ambient context for the scope. ``None`` is a
+    no-op passthrough so entry points can wrap unconditionally."""
+    if ctx is None:
+        yield None
+        return
+    token = _ACTIVE.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _ACTIVE.reset(token)
+
+
+def resolve(
+    ctx: Optional[RequestContext],
+    cfg: Optional[Config],
+    log: Optional[RunLog],
+) -> tuple:
+    """Back-compat resolution for entry points that accept all three of
+    ``ctx``/``cfg``/``log``: explicit ``cfg``/``log`` win (they always did),
+    then the context's, then the defaults. Returns ``(ctx, cfg, log)`` where
+    ``ctx`` may be None (pure offline call)."""
+    if ctx is None:
+        ctx = current_context()
+    if ctx is not None:
+        cfg = cfg or ctx.cfg
+        log = log or ctx.log
+    return ctx, cfg or default_config(), log or RunLog(echo=False)

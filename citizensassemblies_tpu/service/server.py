@@ -1,0 +1,392 @@
+"""graftserve: the async selection-as-a-service front end.
+
+One :class:`SelectionService` owns a request queue, a pool of worker threads,
+a :class:`~citizensassemblies_tpu.service.batcher.CrossRequestBatcher` and a
+:class:`~citizensassemblies_tpu.service.session.TenantRegistry`. Clients
+:meth:`~SelectionService.submit` whole selection instances (pool + quotas +
+k + algorithm ∈ {legacy, leximin, xmin}) and get back a
+:class:`ResultChannel` that streams progress events while the job runs and
+delivers the final allocation plus a per-request exactness-audit stamp.
+
+Request lifecycle::
+
+    submit(SelectionRequest) ──admission──▶ queued ──worker──▶ running
+        │                                                        │
+        ▶ AdmissionError when                    RequestContext installed:
+          serve_queue_depth in-flight           per-request Config + RunLog,
+          requests already exist                tenant session, warm store,
+                                                cross-request batcher
+                                                         │
+    ResultChannel ◀── progress events ── RunLog lines ───┤
+    ResultChannel ◀── ("result", RequestResult + audit stamp) on success
+    ResultChannel ◀── ("error", message) on failure
+
+Concurrency model: ``serve_admission_cap`` worker threads execute requests;
+every solver-visible piece of per-request state rides the ambient
+``RequestContext`` (config, log, warm slots), so concurrent requests are
+fully isolated — the re-entrancy contract ``tests/test_service.py`` pins by
+diffing interleaved runs against their serial twins bit-for-bit. Batchable
+LP fleets from different in-flight requests fuse through the batcher into
+shared padded device dispatches (the cross-request occupancy the serve bench
+measures).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from citizensassemblies_tpu.service.batcher import CrossRequestBatcher
+from citizensassemblies_tpu.service.context import (
+    RequestContext,
+    _next_request_id,
+    use_context,
+)
+from citizensassemblies_tpu.service.session import TenantRegistry
+from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+
+class AdmissionError(RuntimeError):
+    """The service's queue is at ``serve_queue_depth``; retry later."""
+
+
+@dataclasses.dataclass
+class SelectionRequest:
+    """One whole selection job: an instance plus how to solve it.
+
+    Pass either ``instance`` (a ``core.generator`` Instance — the service
+    featurizes it) or a pre-featurized ``(dense, space)`` pair. ``cfg``
+    overrides the service's default config FOR THIS REQUEST only (the
+    re-entrancy refactor exists so that this is safe). ``iterations``/
+    ``seed`` parameterize the LEGACY Monte-Carlo estimator and are ignored
+    by the exact algorithms.
+    """
+
+    algorithm: str = "leximin"  # "legacy" | "leximin" | "xmin"
+    instance: Any = None
+    dense: Any = None
+    space: Any = None
+    households: Optional[np.ndarray] = None
+    cfg: Optional[Config] = None
+    tenant: str = "default"
+    request_id: Optional[str] = None
+    iterations: int = 1_000
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Terminal payload of a request's channel."""
+
+    request_id: str
+    tenant: str
+    algorithm: str
+    allocation: np.ndarray
+    result: Any  # Distribution (leximin/xmin) or LegacyResult (legacy)
+    audit: Dict[str, Any]
+    seconds: float
+    from_memo: bool = False
+
+
+class ResultChannel:
+    """Streamed events of one request: ``("progress", line)`` while the job
+    runs, then exactly one terminal ``("result", RequestResult)`` or
+    ``("error", message)``. Events are retained, so :meth:`events` and
+    :meth:`result` may be called in any order (or repeatedly)."""
+
+    _TERMINAL = ("result", "error")
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._cond = threading.Condition()
+        self._events: List[Tuple[str, Any]] = []
+        self._done = False
+
+    def push(self, kind: str, payload: Any) -> None:
+        with self._cond:
+            self._events.append((kind, payload))
+            if kind in self._TERMINAL:
+                self._done = True
+            self._cond.notify_all()
+
+    def events(self, timeout: Optional[float] = None) -> Iterator[Tuple[str, Any]]:
+        """Yield events in order, blocking for new ones until terminal."""
+        i = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cond:
+                while i >= len(self._events):
+                    if self._done:
+                        return
+                    remaining = (
+                        None if deadline is None else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError(
+                            f"request {self.request_id}: no event within timeout"
+                        )
+                    self._cond.wait(timeout=remaining)
+                event = self._events[i]
+            i += 1
+            yield event
+            if event[0] in self._TERMINAL:
+                return
+
+    def result(self, timeout: Optional[float] = None) -> RequestResult:
+        """Block until the terminal event; raise on request failure."""
+        for kind, payload in self.events(timeout=timeout):
+            if kind == "result":
+                return payload
+            if kind == "error":
+                raise RuntimeError(
+                    f"request {self.request_id} failed: {payload}"
+                )
+        raise RuntimeError(f"request {self.request_id}: channel closed early")
+
+
+class _ChannelLog(RunLog):
+    """A RunLog that additionally streams every line as a progress event."""
+
+    def __init__(self, channel: ResultChannel):
+        super().__init__(echo=False)
+        self._channel = channel
+
+    def emit(self, message: str) -> str:
+        super().emit(message)
+        self._channel.push("progress", message)
+        return message
+
+
+class SelectionService:
+    """Persistent async serving layer over the solver stack."""
+
+    def __init__(self, cfg: Optional[Config] = None):
+        self.cfg = cfg or default_config()
+        #: hard cap on in-flight (queued + running) requests; submit()
+        #: raises AdmissionError beyond it (Config.serve_queue_depth)
+        self.queue_depth = max(int(self.cfg.serve_queue_depth), 1)
+        #: worker threads — the number of requests RUNNING concurrently
+        #: (Config.serve_admission_cap)
+        self.workers = max(int(self.cfg.serve_admission_cap), 1)
+        self.batcher = CrossRequestBatcher(self.cfg)
+        self.tenants = TenantRegistry(
+            cap_per_tenant=int(self.cfg.serve_tenant_memo_cap)
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.workers, thread_name_prefix="graftserve"
+        )
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        self._completed = 0
+        self._failed = 0
+        self._memo_served = 0
+
+    # --- public API ---------------------------------------------------------
+
+    def submit(self, request: SelectionRequest) -> ResultChannel:
+        """Admit one request; returns its streaming channel immediately."""
+        with self._lock:
+            if self._in_flight >= self.queue_depth:
+                raise AdmissionError(
+                    f"queue full: {self._in_flight} requests in flight "
+                    f"(serve_queue_depth={self.queue_depth})"
+                )
+            self._in_flight += 1
+        rid = request.request_id or _next_request_id()
+        channel = ResultChannel(rid)
+        self._pool.submit(self._run_request, request, rid, channel)
+        return channel
+
+    def run(self, request: SelectionRequest, timeout: Optional[float] = None):
+        """Convenience: submit and block for the result."""
+        return self.submit(request).result(timeout=timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "in_flight": self._in_flight,
+                "completed": self._completed,
+                "failed": self._failed,
+                "memo_served": self._memo_served,
+            }
+        out["batcher"] = self.batcher.stats()
+        out["tenants"] = self.tenants.all_stats()
+        return out
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait)
+
+    def __enter__(self) -> "SelectionService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(wait=True)
+
+    # --- the worker ---------------------------------------------------------
+
+    def _featurize(self, request: SelectionRequest):
+        if request.dense is not None:
+            return request.dense, request.space
+        from citizensassemblies_tpu.core.instance import featurize
+
+        return featurize(request.instance)
+
+    def _run_request(
+        self, request: SelectionRequest, rid: str, channel: ResultChannel
+    ) -> None:
+        from citizensassemblies_tpu.utils.guards import CompilationGuard
+
+        t0 = time.monotonic()
+        try:
+            cfg = request.cfg or self.cfg
+            log = _ChannelLog(channel)
+            session = self.tenants.session(request.tenant)
+            ctx = RequestContext(
+                cfg=cfg,
+                log=log,
+                request_id=rid,
+                tenant=request.tenant,
+                warm_store=session.warm_store_for(rid),
+                session=session,
+                batcher=self.batcher,
+            )
+            dense, space = self._featurize(request)
+            fp = self._fingerprint(request, dense, cfg)
+            memo_hit = session.memo_get((request.algorithm, fp))
+            if memo_hit is not None:
+                with self._lock:
+                    self._memo_served += 1
+                    self._completed += 1
+                    self._in_flight -= 1
+                channel.push("progress", f"request {rid}: served from tenant memo")
+                channel.push(
+                    "result",
+                    self._finish(
+                        request, rid, memo_hit, t0, ctx, compiles=0,
+                        from_memo=True,
+                    ),
+                )
+                return
+            with use_context(ctx):
+                with CompilationGuard(name=f"serve_{rid}", log=log) as guard:
+                    result = self._execute(request, dense, space, ctx, fp)
+            session.memo_put((request.algorithm, fp), result)
+            payload = self._finish(
+                request, rid, result, t0, ctx, compiles=guard.count
+            )
+            with self._lock:
+                self._completed += 1
+                self._in_flight -= 1
+            channel.push("result", payload)
+        except BaseException as exc:
+            with self._lock:
+                self._failed += 1
+                self._in_flight -= 1
+            channel.push("error", f"{type(exc).__name__}: {exc}")
+
+    def _fingerprint(self, request: SelectionRequest, dense, cfg: Config) -> str:
+        from citizensassemblies_tpu.utils.checkpoint import problem_fingerprint
+
+        fp = problem_fingerprint(dense, cfg, request.households)
+        if request.algorithm == "legacy":
+            fp = f"{fp}:{request.iterations}:{request.seed}"
+        return fp
+
+    def _execute(self, request: SelectionRequest, dense, space, ctx, fp: str):
+        """Run the request's algorithm with the context installed."""
+        algo = request.algorithm
+        if algo == "legacy":
+            from citizensassemblies_tpu.models.legacy import legacy_probabilities
+
+            return legacy_probabilities(
+                dense, iterations=request.iterations, seed=request.seed,
+                cfg=ctx.cfg, households=request.households,
+            )
+        if algo == "leximin":
+            from citizensassemblies_tpu.models.leximin import (
+                find_distribution_leximin,
+            )
+
+            return find_distribution_leximin(
+                dense, space, cfg=ctx.cfg, households=request.households,
+                log=ctx.log,
+            )
+        if algo == "xmin":
+            from citizensassemblies_tpu.models.xmin import find_distribution_xmin
+
+            # session win: an XMIN request whose LEXIMIN seed was already
+            # solved for the SAME problem (fingerprint match) reuses it —
+            # the expansion + L2 stage is all that runs
+            seed_dist = None
+            if ctx.session is not None:
+                seed_dist = ctx.session.memo_get(("leximin", fp))
+                if seed_dist is not None:
+                    ctx.log.emit(
+                        "XMIN: reusing the tenant session's LEXIMIN seed "
+                        "(fingerprint match)."
+                    )
+            return find_distribution_xmin(
+                dense, space, cfg=ctx.cfg, households=request.households,
+                log=ctx.log, leximin=seed_dist,
+            )
+        raise ValueError(f"unknown algorithm {algo!r} (legacy|leximin|xmin)")
+
+    def _finish(
+        self,
+        request: SelectionRequest,
+        rid: str,
+        result,
+        t0: float,
+        ctx: RequestContext,
+        compiles: int,
+        from_memo: bool = False,
+    ) -> RequestResult:
+        """Assemble the terminal payload + per-request audit stamp."""
+        from citizensassemblies_tpu.utils.memo import memo_evictions_by_owner
+
+        seconds = time.monotonic() - t0
+        allocation = np.asarray(result.allocation)
+        counters = ctx.log.counters
+        audit: Dict[str, Any] = {
+            "request_id": rid,
+            "tenant": request.tenant,
+            "algorithm": request.algorithm,
+            "seconds": round(seconds, 4),
+            "from_memo": from_memo,
+            "xla_compiles": int(compiles),
+            # host↔device round-trip gauge of the decomposition rounds
+            # (ROADMAP item 2's measurement prerequisite) — 0 when the
+            # request never entered the face loop
+            "decomp_host_syncs": int(counters.get("decomp_host_syncs", 0)),
+            "counters": counters,
+            "timers": {k: round(v, 4) for k, v in ctx.log.timers.items()},
+        }
+        # exactness stamp: the solver-carried realization deviation and its
+        # 1e-3 L∞ contract verdict (legacy is a Monte-Carlo estimate — it
+        # carries a draw count instead of a certificate)
+        if hasattr(result, "realization_dev"):
+            audit["realization_dev"] = float(result.realization_dev)
+            audit["contract_ok"] = bool(result.contract_ok)
+        if hasattr(result, "draws_attempted"):
+            audit["draws_attempted"] = int(result.draws_attempted)
+        if ctx.session is not None:
+            audit["session"] = ctx.session.stats()
+            audit["tenant_memo_evictions"] = memo_evictions_by_owner().get(
+                ctx.session.owner, 0
+            )
+        return RequestResult(
+            request_id=rid,
+            tenant=request.tenant,
+            algorithm=request.algorithm,
+            allocation=allocation,
+            result=result,
+            audit=audit,
+            seconds=seconds,
+            from_memo=from_memo,
+        )

@@ -51,6 +51,7 @@ bit-identically.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -59,6 +60,15 @@ from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
 from citizensassemblies_tpu.utils.memo import LRU
+
+
+def _current_context():
+    """The ambient per-request context, imported lazily: the service layer
+    imports the models, which import this module — a top-level import back
+    into ``service`` would be circular."""
+    from citizensassemblies_tpu.service.context import current_context
+
+    return current_context()
 
 
 @dataclasses.dataclass
@@ -127,12 +137,74 @@ def lp_batch_enabled(cfg: Optional[Config]) -> bool:
 _BATCH_CORES: LRU = LRU(cap=6, name="batch_lp_cores")
 
 #: per-bucket dispatch / compile bookkeeping, for the bench's
-#: solves-per-dispatch and per-bucket compile evidence
+#: solves-per-dispatch and per-bucket compile evidence. Updated under
+#: ``_STATS_LOCK``: the serving layer dispatches buckets from several
+#: request worker threads at once, and unlocked dict-increment pairs lose
+#: counts under that load.
 _BUCKET_STATS: Dict[str, Dict[str, int]] = {}
+_STATS_LOCK = threading.Lock()
 
-#: warm-start slots: (warm_key, position) → (x, lam, mu, tail_vars) at the
-#: instance's REAL sizes (host float64 — slots survive bucket changes)
-_WARM_SLOTS: Dict[Tuple[str, int], Tuple[np.ndarray, np.ndarray, np.ndarray, int]] = {}
+
+class WarmSlotStore:
+    """Warm-start slots: (warm_key, position) → (x, λ, μ, tail_vars) at the
+    instance's REAL sizes (host float64 — slots survive bucket changes).
+
+    Formerly one module-level dict — which meant every run in the process
+    shared one namespace of semantic keys (``"decomp_polish_screen"``), a
+    direct warm-iterate collision between concurrent requests. The store is
+    now a class: the module keeps ONE default instance for the offline
+    single-job path (bit-identical behavior), and the service layer gives
+    each request a private store via its ``RequestContext`` (with the
+    semantic key additionally namespaced by tenant/request id). Mutations
+    are lock-guarded; values are tiny host arrays.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots: Dict[
+            Tuple[str, int], Tuple[np.ndarray, np.ndarray, np.ndarray, int]
+        ] = {}
+
+    def get(self, key: Tuple[str, int]):
+        with self._lock:
+            return self._slots.get(key)
+
+    def put(
+        self, key: Tuple[str, int],
+        value: Tuple[np.ndarray, np.ndarray, np.ndarray, int],
+    ) -> None:
+        with self._lock:
+            self._slots[key] = value
+
+    def clear(self, warm_key: Optional[str] = None) -> None:
+        with self._lock:
+            if warm_key is None:
+                self._slots.clear()
+                return
+            for k in [k for k in self._slots if k[0] == warm_key]:
+                del self._slots[k]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+
+#: the offline single-job path's slots — requests under a RequestContext
+#: never touch it (they carry their own store)
+_DEFAULT_WARM_STORE = WarmSlotStore()
+
+
+def _resolve_warm(warm_key: Optional[str]):
+    """(store, scoped_key) for this call: the ambient RequestContext's
+    private store + tenant/request-namespaced key when one is active, the
+    module default otherwise (offline path, unchanged semantics)."""
+    ctx = _current_context()
+    if ctx is None or warm_key is None:
+        store = ctx.warm_store if (ctx is not None and ctx.warm_store is not None) \
+            else _DEFAULT_WARM_STORE
+        return store, warm_key
+    store = ctx.warm_store if ctx.warm_store is not None else _DEFAULT_WARM_STORE
+    return store, ctx.scoped_warm_key(warm_key)
 
 
 def _get_batch_core(max_iters: int, check_every: int):
@@ -215,18 +287,19 @@ def _repad_warm(
 
 
 def clear_warm_slots(warm_key: Optional[str] = None) -> None:
-    """Drop the engine's warm-start slots (all of them, or one caller's)."""
-    if warm_key is None:
-        _WARM_SLOTS.clear()
-        return
-    for k in [k for k in _WARM_SLOTS if k[0] == warm_key]:
-        del _WARM_SLOTS[k]
+    """Drop the engine's warm-start slots (all of them, or one caller's).
+    Under an active RequestContext this clears the REQUEST's private store
+    (with the scoped key), so a run's per-run reset cannot wipe a concurrent
+    request's iterates."""
+    store, scoped = _resolve_warm(warm_key)
+    store.clear(scoped)
 
 
 def bucket_stats() -> Dict[str, Dict[str, int]]:
     """Per-bucket dispatch/solve/compile counts since process start — the
     bench snapshots this around a row to attribute the engine's compiles."""
-    return {k: dict(v) for k, v in _BUCKET_STATS.items()}
+    with _STATS_LOCK:
+        return {k: dict(v) for k, v in _BUCKET_STATS.items()}
 
 
 def solve_lp_batch(
@@ -238,6 +311,7 @@ def solve_lp_batch(
     max_iters: Optional[int] = None,
     mesh=None,
     common_bucket: bool = False,
+    defer: bool = True,
 ):
     """Solve N independent LPs as bucketed, vmapped device calls.
 
@@ -274,6 +348,21 @@ def solve_lp_batch(
     cfg = cfg or default_config()
     if not problems:
         return []
+
+    # cross-request batching (service layer): when the calling thread runs
+    # under a RequestContext whose service installed a CrossRequestBatcher,
+    # this fleet is handed to the batcher, which briefly holds it open for
+    # same-schedule fleets from OTHER concurrent requests and dispatches the
+    # union through this very function (``defer=False`` breaks the
+    # recursion). Mesh-sharded and shared-bucket calls keep their dedicated
+    # layouts. Per-instance results come back in input order either way.
+    if defer and mesh is None and not common_bucket:
+        ctx = _current_context()
+        if ctx is not None and ctx.batcher is not None:
+            return ctx.batcher.submit(
+                problems, ctx=ctx, cfg=cfg, log=log, warm_key=warm_key,
+                tol=tol, max_iters=max_iters,
+            )
     cap = max(int(getattr(cfg, "lp_batch_bucket_max", 4096)), _BUCKET_FLOOR)
     base_tol = float(tol if tol is not None else cfg.pdhg_tol)
     iters = int(max_iters if max_iters is not None else cfg.pdhg_max_iters)
@@ -289,6 +378,7 @@ def solve_lp_batch(
             groups.setdefault(key, []).append(i)
 
     out: List[Optional[LPSolution]] = [None] * len(problems)
+    warm_store, warm_key = _resolve_warm(warm_key)
     core = _get_batch_core(iters, check_every)
     for (m1, m2, nv), idxs in groups.items():
         B_real = len(idxs)
@@ -318,7 +408,7 @@ def solve_lp_batch(
             tols[lane] = float(inst.tol if inst.tol is not None else base_tol)
             warm = inst.warm
             if warm is None and warm_key is not None:
-                slot = _WARM_SLOTS.get((warm_key, i))
+                slot = warm_store.get((warm_key, i))
                 if slot is not None:
                     warm = slot[:3]
                     warm_hits += 1
@@ -333,9 +423,6 @@ def solve_lp_batch(
                 mu0[lane, :m2i] = m_w
 
         bkey = f"{m1}x{m2}x{nv}x{B}"
-        stats = _BUCKET_STATS.setdefault(
-            bkey, {"dispatches": 0, "solves": 0, "compiles": 0}
-        )
         # operands are materialized to device arrays BEFORE the guard scope
         # (the engine's whole point is one explicit upload per bucket); with
         # a mesh the batch axis is laid out over the devices so the jitted
@@ -363,9 +450,13 @@ def solve_lp_batch(
             mu = np.asarray(mu, dtype=np.float64)
             it = np.asarray(it)
             res = np.asarray(res)
-        stats["dispatches"] += 1
-        stats["solves"] += B_real
-        stats["compiles"] += guard.count
+        with _STATS_LOCK:
+            stats = _BUCKET_STATS.setdefault(
+                bkey, {"dispatches": 0, "solves": 0, "compiles": 0}
+            )
+            stats["dispatches"] += 1
+            stats["solves"] += B_real
+            stats["compiles"] += guard.count
         if log is not None:
             log.count("lp_batch_dispatches")
             log.count("lp_batch_solves", B_real)
@@ -394,7 +485,7 @@ def solve_lp_batch(
                 kkt=res_i,
             )
             if warm_key is not None:
-                _WARM_SLOTS[(warm_key, i)] = (xi, li, mi, int(inst.tail_vars))
+                warm_store.put((warm_key, i), (xi, li, mi, int(inst.tail_vars)))
     return out
 
 
@@ -537,9 +628,6 @@ def solve_polish_screen_ell(
 
     core = _get_polish_screen_ell_core(int(max_iters), int(cfg.pdhg_check_every))
     bkey = f"ell_{T}x{Cp}x{ell.k_pad}x{B}"
-    stats = _BUCKET_STATS.setdefault(
-        bkey, {"dispatches": 0, "solves": 0, "compiles": 0}
-    )
     operands = (
         jnp.asarray(idx_p), jnp.asarray(val_p), jnp.asarray(v, jnp.float32),
         jnp.asarray(colmask), jnp.asarray(x0), jnp.asarray(lam0),
@@ -553,9 +641,13 @@ def solve_polish_screen_ell(
         mu = np.asarray(mu, dtype=np.float64)
         it = np.asarray(it)
         res = np.asarray(res)
-    stats["dispatches"] += 1
-    stats["solves"] += B_real
-    stats["compiles"] += guard.count
+    with _STATS_LOCK:
+        stats = _BUCKET_STATS.setdefault(
+            bkey, {"dispatches": 0, "solves": 0, "compiles": 0}
+        )
+        stats["dispatches"] += 1
+        stats["solves"] += B_real
+        stats["compiles"] += guard.count
     if log is not None:
         log.count("lp_batch_dispatches")
         log.count("lp_batch_solves", B_real)

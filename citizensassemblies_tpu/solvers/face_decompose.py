@@ -640,6 +640,7 @@ def realize_profile(
     master_cap: int = 6_000,
     use_pdhg: Optional[bool] = None,
     cfg=None,
+    ctx=None,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], float, int]:
     """Find compositions + probabilities with ``‖Mp − v‖∞ ≤ accept``.
 
@@ -660,15 +661,15 @@ def realize_profile(
     Returns ``(compositions int32 [C, T], probabilities float64 [C],
     eps, lp_solves)``; callers fall back to stage CG when ``eps > accept``.
     """
+    from citizensassemblies_tpu.service.context import resolve as resolve_context
     from citizensassemblies_tpu.solvers.cg_typespace import _decomp_lp
 
-    log = log or RunLog(echo=False)
+    # per-request re-entrancy: resolve cfg/log through the ambient (or
+    # explicitly passed) RequestContext; the context is (re)installed around
+    # the round loop below so the batched-engine calls see it
+    ctx, cfg, log = resolve_context(ctx, cfg, log)
     T = reduction.T
     m = reduction.msize.astype(np.float64)
-    if cfg is None:
-        from citizensassemblies_tpu.utils.config import default_config
-
-        cfg = default_config()
     if use_pdhg is None:
         import jax
 
@@ -836,6 +837,7 @@ def realize_profile(
                         ell_sup, v, caps, warms, tol=0.25 * master_tol,
                         max_iters=24_576, cfg=cfg, log=log,
                     )
+                log.count("decomp_host_syncs")
             else:
                 insts = []
                 for c_ in caps:
@@ -855,6 +857,7 @@ def realize_profile(
                         insts, cfg=cfg, log=log, warm_key="decomp_polish_screen",
                         max_iters=24_576, common_bucket=True,
                     )
+                log.count("decomp_host_syncs")
             lp_solves += 1
             best_s = None
             for c_, sol in zip(caps, sols):
@@ -903,6 +906,7 @@ def realize_profile(
                     max_iters=98_304,
                 )
             lp_solves += 1
+            log.count("decomp_host_syncs")  # deep device polish round trip
             p_s = np.maximum(sol.x[: MTs.shape[1]], 0.0)
             tot = p_s.sum()
             if np.isfinite(tot) and tot > 0:
@@ -981,7 +985,10 @@ def realize_profile(
     # be immediately visible next to the warm-start/overlap attribution
     from contextlib import ExitStack
 
+    from citizensassemblies_tpu.service.context import use_context
+
     _guards = ExitStack()
+    _guards.enter_context(use_context(ctx))
     _guards.enter_context(CompilationGuard("decomp", log=log))
     try:
         for rnd in range(max_rounds):
@@ -1032,6 +1039,11 @@ def realize_profile(
                         )
                     pdhg_warm = None
                     lp_solves += 1
+                    # one host→device upload + device→host harvest per
+                    # sharded master (the decomp_host_syncs gauge: ROADMAP
+                    # item 2 wants the CG round's round-trip count measured
+                    # before device-resident pricing can claim to kill it)
+                    log.count("decomp_host_syncs")
                 else:
                     # adaptive budget: far from acceptance the duals only need
                     # to be roughly right to aim the expansion; near it the
@@ -1063,6 +1075,9 @@ def realize_profile(
                             ell=ell_now if use_sparse else None,
                         )
                     lp_solves += 1
+                    # device master: operand upload + iterate harvest is one
+                    # host↔device round trip of the CG round
+                    log.count("decomp_host_syncs")
                     polish_warm = pdhg_warm
                     if not warm_enabled:
                         pdhg_warm = None
@@ -1196,6 +1211,10 @@ def realize_profile(
                             batched=batched_expand, cfg=cfg,
                         )
                     )
+                if batched_expand:
+                    # the jitted move screen ships the candidate block down
+                    # and the kept-move indices back up once per round
+                    log.count("decomp_host_syncs")
             if (
                 T <= cfg.decomp_host_master_max_types
                 and rnd == 0

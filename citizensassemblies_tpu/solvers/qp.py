@@ -424,6 +424,7 @@ def solve_final_primal_l2(
     floor_donor: Optional[np.ndarray] = None,
     cfg=None,
     anchor_if_above: Optional[float] = None,
+    ctx=None,
 ) -> Tuple[np.ndarray, float]:
     """Committee probabilities realizing ``target`` within the minimal ε, with
     minimal L2 norm (maximal spread). Returns (p, ε). ``log`` (a ``RunLog``)
@@ -445,9 +446,12 @@ def solve_final_primal_l2(
     alone can exceed the caller's spread band (leximin realizations budget
     up to ~9e-4 at n ≥ 200 vs XMIN's 8e-4 band), which would silently
     disable the support expansion the caller exists for."""
-    from citizensassemblies_tpu.utils.logging import RunLog
+    from citizensassemblies_tpu.service.context import resolve as resolve_context
 
-    log = log or RunLog(echo=False)
+    # per-request re-entrancy: cfg/log resolve through the ambient (or
+    # explicit) RequestContext; its tenant session additionally memoizes the
+    # packed ELL operands below
+    ctx, cfg, log = resolve_context(ctx, cfg, log)
     if anchor_if_above is None:
         # derive the gate from the configured spread band so a tightened
         # band cannot open a (gate, band) window where the anchor is
@@ -469,8 +473,23 @@ def solve_final_primal_l2(
     p_fill = float(np.count_nonzero(Pnp)) / max(Pnp.size, 1)
     ell = None
     if sparse_enabled(cfg, p_fill):
-        with log.timer("sparse_pack"):
-            ell = EllPack.from_rows(Pnp.astype(np.float32))
+        # tenant-session pack memo (service layer): a repeat solve over the
+        # SAME portfolio — an XMIN re-submission, a warm re-solve — reuses
+        # the packed indices/values instead of re-packing (content-hashed,
+        # LRU-capped per tenant with eviction attribution)
+        pack_key = None
+        if ctx is not None and ctx.session is not None:
+            import hashlib
+
+            pack_key = "ell:" + hashlib.sha256(Pnp.tobytes()).hexdigest()
+            ell = ctx.session.pack_get(pack_key)
+            if ell is not None:
+                log.count("session_pack_hit")
+        if ell is None:
+            with log.timer("sparse_pack"):
+                ell = EllPack.from_rows(Pnp.astype(np.float32))
+            if pack_key is not None:
+                ctx.session.pack_put(pack_key, ell)
         log.gauge("sparse_fill_pct", int(round(100 * ell.fill)))
         log.count("sparse_hit")
     else:

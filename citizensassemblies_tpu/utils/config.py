@@ -265,6 +265,29 @@ class Config:
     #: no fallback to ship).
     agent_space_budget_s: float = 0.0
 
+    # --- selection service (citizensassemblies_tpu/service) -------------------
+    #: hard cap on in-flight (admitted, not yet finished) requests per
+    #: ``SelectionService``; ``submit()`` raises ``AdmissionError`` beyond it
+    #: so back-pressure reaches the client instead of an unbounded queue.
+    serve_queue_depth: int = 256
+    #: worker threads per service — the number of requests RUNNING
+    #: concurrently. More workers widen the cross-request batching window's
+    #: catch (more fleets in flight to fuse) at the cost of host memory per
+    #: running solve; the queue above absorbs bursts beyond it.
+    serve_admission_cap: int = 8
+    #: how long (milliseconds) the cross-request batcher's group leader
+    #: holds a window open for OTHER requests' same-schedule LP fleets
+    #: before dispatching the union. 0 disables coalescing (every fleet
+    #: dispatches solo, the pre-service behavior); a few ms is enough —
+    #: the window only needs to catch fleets already in flight on other
+    #: worker threads, not wait for future ones.
+    serve_batch_window_ms: float = 4.0
+    #: per-tenant memory cap: max entries in EACH of a tenant session's LRU
+    #: stores (warm-start slot stores, result memos, packed ELL operands).
+    #: Evictions are counted per tenant (``memo_evictions_by_owner``) and
+    #: reported on the request audit stamp.
+    serve_tenant_memo_cap: int = 8
+
     # --- backends -------------------------------------------------------------
     #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),
     #: "highs" (host scipy/HiGHS LPs and MILPs — the cross-check backend), or

@@ -5,11 +5,23 @@ The reference's algorithms accumulate human-readable progress into
 returns alongside results, and ``analyze_instance`` tees console output into
 ``analysis/<instance>_<k>_statistics.txt`` via a ``log()`` closure
 (``analysis.py:552-556``). ``RunLog`` preserves both behaviors behind one object.
+
+Thread safety: the serving layer (``citizensassemblies_tpu/service``) runs
+CONCURRENT requests over solver code that mutates a RunLog's counter/timer
+dicts from whatever thread happens to be executing — including the engine-
+level logs the cross-request batcher updates from several requests' worker
+threads at once. ``dict.get``+store is not atomic under that load (two
+threads read the same old value and one increment is lost), so every mutation
+of ``lines``/``_timers``/``_counters`` takes the instance lock. The lock is
+uncontended in the single-threaded offline path (a few ns per count), and
+``tests/test_service.py`` hammers ``count()`` from a pool to pin the
+no-lost-increments contract.
 """
 
 from __future__ import annotations
 
 import sys
+import threading
 import time
 from contextlib import contextmanager
 from pathlib import Path
@@ -25,10 +37,14 @@ class RunLog:
         self.file = file
         self._timers: dict[str, float] = {}
         self._counters: dict[str, int] = {}
+        #: guards every mutation of lines/_timers/_counters — concurrent
+        #: requests in the serving layer count into shared engine logs
+        self._mutex = threading.Lock()
 
     def emit(self, message: str) -> str:
         """Record a line (the reference's ``_print`` at ``leximin.py:54-56``)."""
-        self.lines.append(message)
+        with self._mutex:
+            self.lines.append(message)
         if self.echo:
             print(message)
         if self.file is not None:
@@ -42,7 +58,8 @@ class RunLog:
             print(*info)
         if self.file is not None:
             self.file.write(msg + "\n")
-        self.lines.append(msg)
+        with self._mutex:
+            self.lines.append(msg)
 
     @contextmanager
     def timer(self, name: str):
@@ -50,27 +67,33 @@ class RunLog:
         try:
             yield
         finally:
-            self._timers[name] = self._timers.get(name, 0.0) + time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            with self._mutex:
+                self._timers[name] = self._timers.get(name, 0.0) + dt
 
     @property
     def timers(self) -> dict:
-        return dict(self._timers)
+        with self._mutex:
+            return dict(self._timers)
 
     def count(self, name: str, inc: int = 1) -> None:
         """Accumulate a named event counter (e.g. warm-start hits, overlap
         harvests) — the discrete sibling of :meth:`timer`, rendered by
         :func:`citizensassemblies_tpu.utils.profiling.format_counters`."""
-        self._counters[name] = self._counters.get(name, 0) + inc
+        with self._mutex:
+            self._counters[name] = self._counters.get(name, 0) + inc
 
     def gauge(self, name: str, value) -> None:
         """Record a point-in-time VALUE (latest wins, no accumulation) into
         the counters channel — e.g. the measured ELL fill ratio of the last
         pack, which a bench row wants as a level, not a sum."""
-        self._counters[name] = value
+        with self._mutex:
+            self._counters[name] = value
 
     @property
     def counters(self) -> dict:
-        return dict(self._counters)
+        with self._mutex:
+            return dict(self._counters)
 
 
 @contextmanager

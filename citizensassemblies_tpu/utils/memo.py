@@ -11,20 +11,48 @@ constants pin) forever. :class:`LRU` bounds each cache with
 least-recently-used eviction and counts every eviction into one module
 counter, so cache pressure is observable (``memo_evictions()`` — bench
 evidence rows record it).
+
+Eviction attribution: every LRU entry carries an OWNER (default: the cache's
+own name), and evictions are counted both process-wide and per owner
+(:func:`memo_evictions_by_owner`). The serving layer
+(``citizensassemblies_tpu/service``) caps each tenant's session state —
+warm-start slots, packed ELL operands, result memos — in tenant-owned LRUs
+and inserts with ``owner="tenant:<name>"``, so when a cache cycles under
+memory pressure the per-request audit stamp can say WHICH tenant's entries
+were evicted instead of reporting one opaque process-wide number. Counters
+are lock-guarded: concurrent requests evict from shared caches on their own
+worker threads.
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
-from typing import Any, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional
+
+#: guards the module-wide eviction counters (service worker threads evict
+#: concurrently); LRU instances reuse it — evictions are rare enough that a
+#: single shared lock is simpler than per-cache locks and never hot
+_EVICTION_LOCK = threading.Lock()
 
 #: process-wide eviction count across every LRU memo (observability only)
 _EVICTIONS = 0
+
+#: eviction counts split by the evicted ENTRY's owner (cache name, or the
+#: ``tenant:<name>`` owner tag the serving layer inserts with)
+_EVICTIONS_BY_OWNER: Dict[str, int] = {}
 
 
 def memo_evictions() -> int:
     """Total LRU memo evictions since process start, across all caches."""
     return _EVICTIONS
+
+
+def memo_evictions_by_owner() -> Dict[str, int]:
+    """Eviction counts keyed by the evicted entry's owner — the per-tenant
+    attribution the service's audit stamps report (a copy; safe to hold)."""
+    with _EVICTION_LOCK:
+        return dict(_EVICTIONS_BY_OWNER)
 
 
 class LRU:
@@ -33,13 +61,15 @@ class LRU:
     Drop-in for the dict operations the memo sites use (``get``, item
     assignment, ``in``, ``len``, ``clear``, iteration over keys). A hit
     refreshes recency; an insert beyond ``cap`` evicts the oldest entry and
-    bumps the global eviction counter.
+    bumps the global eviction counter — attributed to the evicted entry's
+    owner (:meth:`put`), or to the cache's name when none was given.
     """
 
     def __init__(self, cap: int, name: str = ""):
         self.cap = max(int(cap), 1)
         self.name = name
         self._d: "OrderedDict[Any, Any]" = OrderedDict()
+        self._owners: Dict[Any, str] = {}
         self.evictions = 0
 
     def get(self, key, default: Optional[Any] = None):
@@ -53,15 +83,31 @@ class LRU:
         self._d.move_to_end(key)
         return self._d[key]
 
-    def __setitem__(self, key, value) -> None:
+    def put(self, key, value, owner: Optional[str] = None) -> None:
+        """Insert with an explicit OWNER attribution for eviction accounting
+        (the service inserts tenant session state with ``tenant:<name>``).
+        ``lru[key] = value`` is equivalent with ``owner=None`` — the eviction
+        then counts against the cache's own name."""
         global _EVICTIONS
         if key in self._d:
             self._d.move_to_end(key)
         self._d[key] = value
+        if owner is not None:
+            self._owners[key] = owner
+        else:
+            self._owners.pop(key, None)
         while len(self._d) > self.cap:
-            self._d.popitem(last=False)
+            old_key, _ = self._d.popitem(last=False)
+            old_owner = self._owners.pop(old_key, None) or self.name or "unnamed"
             self.evictions += 1
-            _EVICTIONS += 1
+            with _EVICTION_LOCK:
+                _EVICTIONS += 1
+                _EVICTIONS_BY_OWNER[old_owner] = (
+                    _EVICTIONS_BY_OWNER.get(old_owner, 0) + 1
+                )
+
+    def __setitem__(self, key, value) -> None:
+        self.put(key, value)
 
     def __contains__(self, key) -> bool:
         return key in self._d
@@ -74,3 +120,4 @@ class LRU:
 
     def clear(self) -> None:
         self._d.clear()
+        self._owners.clear()

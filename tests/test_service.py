@@ -1,0 +1,354 @@
+"""graftserve: the async selection service and its re-entrancy contract.
+
+What is pinned here:
+
+* **RunLog thread safety** — ``count()`` hammered from a pool loses no
+  increments (the service counts into shared engine logs from concurrent
+  request threads).
+* **Re-entrancy bit-identity** — two INTERLEAVED leximin solves with
+  *different* Config knobs each honor their own config and produce
+  allocations bit-identical to their serial twins: the per-request
+  RequestContext isolates knobs, counters, and warm slots.
+* **Service end-to-end** — submitted requests match direct solver calls,
+  progress streams, and the audit stamp carries the exactness fields.
+* **Cross-request batching** — fleets submitted from two threads inside the
+  window fuse into one engine dispatch, with per-request results identical
+  to solo dispatches.
+* **Warm-slot isolation** — a context's warm slots land in ITS store under
+  a tenant/request-scoped key; the module default store is untouched.
+* **Per-tenant eviction attribution** — overflowing a tenant session's LRU
+  counts into ``memo_evictions_by_owner()`` under that tenant.
+* **Admission control** — ``serve_queue_depth`` in-flight requests reject
+  the next submit.
+* **decomp_host_syncs** — the face loop's device rounds count host↔device
+  round trips into the gauge the audit stamp and bench rows report.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import random_instance, skewed_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.service import (
+    AdmissionError,
+    CrossRequestBatcher,
+    RequestContext,
+    SelectionRequest,
+    SelectionService,
+    use_context,
+)
+from citizensassemblies_tpu.utils.config import default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+from citizensassemblies_tpu.utils.memo import LRU, memo_evictions_by_owner
+
+
+def _tiny(seed=0, n=24, k=5):
+    return featurize(random_instance(n=n, k=k, n_categories=2, seed=seed))
+
+
+# --- RunLog thread safety ----------------------------------------------------
+
+
+def test_runlog_count_no_lost_increments():
+    """dict-get+store is not atomic; the lock must make it so."""
+    log = RunLog(echo=False)
+    workers, per = 8, 5_000
+
+    def hammer():
+        for _ in range(per):
+            log.count("hits")
+        return True
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        assert all(f.result() for f in [pool.submit(hammer) for _ in range(workers)])
+    assert log.counters["hits"] == workers * per
+
+
+def test_runlog_timer_and_gauge_concurrent():
+    log = RunLog(echo=False)
+
+    def one(i):
+        with log.timer("t"):
+            pass
+        log.gauge("g", i)
+        return True
+
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        assert all(f.result() for f in [pool.submit(one, i) for i in range(64)])
+    assert log.timers["t"] >= 0.0
+    assert 0 <= log.counters["g"] < 64
+
+
+# --- re-entrancy: interleaved solves, different knobs, bit-identical ---------
+
+
+def test_interleaved_leximin_bit_identical_to_serial():
+    """Two concurrent requests with DIFFERENT configs (batched engine on vs
+    off, sparse layer forced vs disabled) must each honor their own knobs
+    and reproduce their serial twins bit-for-bit."""
+    d1, s1 = _tiny(seed=1, n=32, k=6)
+    d2, s2 = _tiny(seed=2, n=40, k=7)
+    cfg_a = default_config().replace(lp_batch=True, sparse_ops=False)
+    cfg_b = default_config().replace(lp_batch=False, sparse_ops=True)
+
+    serial_a = find_distribution_leximin(d1, s1, cfg=cfg_a)
+    serial_b = find_distribution_leximin(d2, s2, cfg=cfg_b)
+
+    ctx_a = RequestContext.create(cfg=cfg_a, tenant="a", request_id="ra")
+    ctx_b = RequestContext.create(cfg=cfg_b, tenant="b", request_id="rb")
+    barrier = threading.Barrier(2)
+
+    def run(ctx, d, s):
+        barrier.wait(timeout=30)  # both requests genuinely in flight
+        return find_distribution_leximin(d, s, ctx=ctx)
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        fa = pool.submit(run, ctx_a, d1, s1)
+        fb = pool.submit(run, ctx_b, d2, s2)
+        conc_a, conc_b = fa.result(timeout=300), fb.result(timeout=300)
+
+    np.testing.assert_array_equal(conc_a.allocation, serial_a.allocation)
+    np.testing.assert_array_equal(conc_b.allocation, serial_b.allocation)
+    np.testing.assert_array_equal(conc_a.probabilities, serial_a.probabilities)
+    np.testing.assert_array_equal(conc_b.probabilities, serial_b.probabilities)
+    # each run's counters landed on its OWN log, not a shared one
+    assert ctx_a.log.counters is not None and ctx_b.log.counters is not None
+    assert ctx_a.log.lines and ctx_b.log.lines
+
+
+# --- service end-to-end ------------------------------------------------------
+
+
+def test_service_end_to_end_parity_stream_and_audit():
+    cfg = default_config().replace(lp_batch=True, serve_batch_window_ms=5.0)
+    insts = [random_instance(n=24 + 8 * i, k=5, n_categories=2, seed=i) for i in range(3)]
+    with SelectionService(cfg) as svc:
+        chans = [
+            svc.submit(
+                SelectionRequest(instance=inst, algorithm="leximin", tenant=f"t{i}")
+            )
+            for i, inst in enumerate(insts)
+        ]
+        results = [c.result(timeout=300) for c in chans]
+    for inst, res in zip(insts, results):
+        d, s = featurize(inst)
+        ref = find_distribution_leximin(d, s, cfg=cfg)
+        np.testing.assert_array_equal(res.allocation, ref.allocation)
+        assert res.audit["contract_ok"] is True
+        assert res.audit["realization_dev"] <= 1e-3
+        for field in ("decomp_host_syncs", "xla_compiles", "counters", "timers",
+                      "session", "tenant_memo_evictions"):
+            assert field in res.audit, field
+    # the channel retained the progress stream (RunLog lines)
+    events = list(chans[0].events(timeout=5))
+    kinds = [k for k, _ in events]
+    assert kinds[-1] == "result" and "progress" in kinds
+
+
+def test_service_memo_and_xmin_seed_reuse():
+    cfg = default_config()
+    inst = random_instance(n=24, k=5, n_categories=2, seed=3)
+    with SelectionService(cfg) as svc:
+        r1 = svc.run(SelectionRequest(instance=inst, tenant="memo"), timeout=300)
+        assert not r1.from_memo
+        # identical re-submission: served from the tenant memo
+        r2 = svc.run(SelectionRequest(instance=inst, tenant="memo"), timeout=300)
+        assert r2.from_memo
+        np.testing.assert_array_equal(r1.allocation, r2.allocation)
+        # XMIN on the same problem reuses the session's LEXIMIN seed
+        rx = svc.run(
+            SelectionRequest(instance=inst, algorithm="xmin", tenant="memo"),
+            timeout=300,
+        )
+        assert any("reusing the tenant session's LEXIMIN seed" in line
+                   for line in rx.result.output_lines)
+        # XMIN preserves the leximin profile within its band
+        assert float(np.abs(np.sort(rx.allocation) - np.sort(r1.allocation)).max()) \
+            <= 1e-3
+
+
+def test_service_legacy_algorithm_parity():
+    from citizensassemblies_tpu.models.legacy import legacy_probabilities
+
+    cfg = default_config()
+    inst = random_instance(n=24, k=5, n_categories=2, seed=4)
+    d, _s = featurize(inst)
+    ref = legacy_probabilities(d, iterations=300, seed=7, cfg=cfg)
+    with SelectionService(cfg) as svc:
+        res = svc.run(
+            SelectionRequest(instance=inst, algorithm="legacy", iterations=300, seed=7),
+            timeout=300,
+        )
+    np.testing.assert_array_equal(res.allocation, ref.allocation)
+    assert res.audit["draws_attempted"] >= 300
+
+
+def test_admission_control_queue_depth():
+    cfg = default_config().replace(serve_queue_depth=2, serve_admission_cap=1)
+    svc = SelectionService(cfg)
+    try:
+        # white-box: pin the in-flight count at the depth — submit must
+        # reject deterministically (no reliance on a request staying slow)
+        with svc._lock:
+            svc._in_flight = svc.queue_depth
+        with pytest.raises(AdmissionError):
+            svc.submit(SelectionRequest(instance=random_instance(n=24, k=5,
+                                                                 n_categories=2)))
+        with svc._lock:
+            svc._in_flight = 0
+    finally:
+        svc.shutdown()
+
+
+# --- cross-request batching --------------------------------------------------
+
+
+def test_cross_request_batcher_fuses_and_matches_solo():
+    """Two threads submit same-schedule fleets inside the window: one engine
+    dispatch, per-request results identical to solo dispatches."""
+    from citizensassemblies_tpu.solvers.batch_lp import (
+        final_primal_batch_lp,
+        solve_lp_batch,
+    )
+
+    rng = np.random.default_rng(0)
+    cfg = default_config().replace(lp_batch=True, serve_batch_window_ms=500.0)
+
+    def fleet(seed):
+        out = []
+        r = np.random.default_rng(seed)
+        for _ in range(3):
+            P = r.random((16, 8)) < 0.5
+            q = r.random(16)
+            q /= q.sum()
+            out.append(final_primal_batch_lp(P, P.T.astype(np.float64) @ q))
+        return out
+
+    fleets = [fleet(1), fleet(2)]
+    solo = [
+        solve_lp_batch(f, cfg=cfg, max_iters=20_000, defer=False) for f in fleets
+    ]
+
+    batcher = CrossRequestBatcher(cfg)
+    ctxs = [
+        RequestContext.create(cfg=cfg, tenant=f"t{i}", request_id=f"r{i}",
+                              batcher=batcher)
+        for i in range(2)
+    ]
+    barrier = threading.Barrier(2)
+
+    def run(i):
+        barrier.wait(timeout=30)
+        with use_context(ctxs[i]):
+            return solve_lp_batch(fleets[i], cfg=cfg, max_iters=20_000)
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        fused = [f.result(timeout=120) for f in [pool.submit(run, i) for i in range(2)]]
+
+    stats = batcher.stats()
+    assert stats["submissions"] == 2
+    assert stats["fused_dispatches"] >= 1, stats
+    assert stats["max_requests_fused"] == 2, stats
+    for got, want in zip(fused, solo):
+        for g, w in zip(got, want):
+            # identical lanes of an identical padded bucket: bit-identical
+            np.testing.assert_array_equal(g.x, w.x)
+            assert g.objective == w.objective
+    _ = rng  # noqa: F841 - seed source for future fleet variants
+
+
+def test_warm_slot_isolation_across_contexts():
+    from citizensassemblies_tpu.solvers.batch_lp import (
+        _DEFAULT_WARM_STORE,
+        WarmSlotStore,
+        final_primal_batch_lp,
+        solve_lp_batch,
+    )
+
+    rng = np.random.default_rng(5)
+    P = rng.random((16, 8)) < 0.5
+    q = rng.random(16)
+    q /= q.sum()
+    inst = [final_primal_batch_lp(P, P.T.astype(np.float64) @ q)]
+    cfg = default_config().replace(lp_batch=True)
+
+    store_a, store_b = WarmSlotStore(), WarmSlotStore()
+    ctx_a = RequestContext.create(cfg=cfg, tenant="ta", request_id="r1",
+                                  warm_store=store_a)
+    ctx_b = RequestContext.create(cfg=cfg, tenant="tb", request_id="r2",
+                                  warm_store=store_b)
+    before_default = len(_DEFAULT_WARM_STORE)
+    with use_context(ctx_a):
+        solve_lp_batch(inst, cfg=cfg, warm_key="probe", max_iters=10_000)
+    assert len(store_a) == 1
+    assert store_a.get(("ta/r1/probe", 0)) is not None
+    assert len(store_b) == 0
+    assert len(_DEFAULT_WARM_STORE) == before_default
+    # a request-scoped clear drops only that context's slots
+    with use_context(ctx_a):
+        from citizensassemblies_tpu.solvers.batch_lp import clear_warm_slots
+
+        clear_warm_slots("probe")
+    assert len(store_a) == 0
+
+
+# --- per-tenant eviction attribution ----------------------------------------
+
+
+def test_lru_owner_attributed_evictions():
+    before = memo_evictions_by_owner().get("tenant:evict-me", 0)
+    cache = LRU(cap=2, name="tenant:evict-me:memo")
+    for i in range(4):
+        cache.put(i, i, owner="tenant:evict-me")
+    after = memo_evictions_by_owner().get("tenant:evict-me", 0)
+    assert after - before == 2
+    assert cache.evictions == 2
+
+
+def test_tenant_session_caps_and_attributes():
+    from citizensassemblies_tpu.service.session import TenantSession
+
+    sess = TenantSession("cap-t", cap=2)
+    before = memo_evictions_by_owner().get(sess.owner, 0)
+    for i in range(4):
+        sess.memo_put(f"fp{i}", object())
+    assert sess.memo_get("fp3") is not None
+    assert sess.memo_get("fp0") is None  # evicted
+    assert memo_evictions_by_owner().get(sess.owner, 0) - before == 2
+    assert sess.stats()["evictions"] == 2
+
+
+# --- decomp_host_syncs gauge -------------------------------------------------
+
+
+def test_decomp_host_syncs_counts_device_rounds():
+    """Forcing device masters on the face loop must tick the gauge once per
+    device round trip; the pure host-master run keeps it at zero."""
+    from citizensassemblies_tpu.solvers.cg_typespace import (
+        CompositionOracle,
+        _leximin_relaxation,
+        _slice_relaxation,
+    )
+    from citizensassemblies_tpu.solvers.face_decompose import realize_profile
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+    dense, _space = featurize(skewed_instance(n=120, k=12, n_categories=3, seed=1))
+    red = TypeReduction(dense)
+    v_relax, _x = _leximin_relaxation(red, RunLog(echo=False))
+    seeds = _slice_relaxation(v_relax * red.msize.astype(np.float64), red, R=8)
+    # host-master route (CPU default): no device round trips
+    log_host = RunLog(echo=False)
+    realize_profile(red, v_relax, list(seeds), CompositionOracle(red),
+                    accept=5e-3, log=log_host, max_rounds=3, use_pdhg=False)
+    assert log_host.counters.get("decomp_host_syncs", 0) == 0
+    # device-master route forced: every master is a host↔device round trip
+    cfg = default_config().replace(decomp_host_master_max_types=0)
+    log_dev = RunLog(echo=False)
+    realize_profile(red, v_relax, list(seeds), CompositionOracle(red),
+                    accept=5e-3, log=log_dev, max_rounds=3, use_pdhg=True,
+                    cfg=cfg)
+    assert log_dev.counters.get("decomp_host_syncs", 0) >= 1, log_dev.counters
