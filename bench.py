@@ -75,6 +75,29 @@ def _attach_profile_audit(audit: dict, dense, probs, covered) -> None:
     audit["audit_s"] = round(_t.time() - t0, 1)
 
 
+def _host_sync_stamp(counters: dict):
+    """Per-row host↔device round-trip evidence of the face-decomposition
+    loop (ROADMAP item 2): the ``decomp_host_syncs`` gauge total, the round
+    count, and the per-round ratios — ``steady_per_round`` excludes the
+    end-game polish syncs, which is the number the device-pricing target
+    (≤ 1 per steady-state CG round) is asserted against in ``--smoke``."""
+    total = counters.get("decomp_host_syncs", 0)
+    rounds = counters.get("decomp_rounds", 0)
+    if not total and not rounds:
+        return None
+    out = {"total": int(total), "rounds": int(rounds)}
+    if rounds:
+        steady = total - counters.get("decomp_polish_syncs", 0)
+        out["per_round"] = round(total / rounds, 2)
+        out["steady_per_round"] = round(steady / rounds, 2)
+    for key in ("decomp_oracle_device_hit", "decomp_oracle_device_miss",
+                "oracle_backend_native", "oracle_backend_highs",
+                "oracle_backend_device"):
+        if key in counters:
+            out[key.replace("decomp_oracle_", "").replace("oracle_backend_", "oracle_")] = counters[key]
+    return out
+
+
 def _sparse_stamp(timers: dict, counters: dict):
     """Per-row sparse-operator evidence (solvers/sparse_ops): pack
     overhead, last measured fill, and the hit/miss routing decisions — so
@@ -349,6 +372,9 @@ def main() -> None:
                 )
                 if sparse_row:
                     detail[key]["sparse"] = sparse_row
+                sync_row = _host_sync_stamp(runs[len(runs) // 2][2])
+                if sync_row:
+                    detail[key]["decomp_host_syncs"] = sync_row
                 if audit is not None:
                     detail[key]["exactness_audit"] = audit
                 if key == "sf_e_skewed_types":
@@ -511,6 +537,9 @@ def main() -> None:
         xmin_sparse = _sparse_stamp(xlog.timers, dict(xlog.counters))
         if xmin_sparse:
             detail["xmin_sf_e_skewed"]["sparse"] = xmin_sparse
+        xmin_sync = _host_sync_stamp(dict(xlog.counters))
+        if xmin_sync:
+            detail["xmin_sf_e_skewed"]["decomp_host_syncs"] = xmin_sync
 
         # household-constrained runs (VERDICT r2 #5 / r3 #5). The reference
         # handles households by staying in agent space forever
@@ -593,6 +622,9 @@ def main() -> None:
             hh_sparse = _sparse_stamp(hlog.timers, hlog.counters)
             if hh_sparse:
                 detail[tag]["sparse"] = hh_sparse
+            hh_sync = _host_sync_stamp(hlog.counters)
+            if hh_sync:
+                detail[tag]["decomp_host_syncs"] = hh_sync
 
         _run_households(
             "households_n400",
@@ -699,6 +731,10 @@ def main() -> None:
                 "decomp_s": row.get("phase_times", {}).get("decomp"),
                 "linf": row.get("alloc_linf_dev"),
                 "profile_ok": audit.get("profile_all_within_tol"),
+                # the device-pricing target: host↔device syncs per CG round
+                "host_syncs_per_round": (row.get("decomp_host_syncs") or {}).get(
+                    "per_round"
+                ),
             }
     if "xmin_sf_e_skewed" in detail:
         xr = detail["xmin_sf_e_skewed"]
@@ -724,7 +760,11 @@ def smoke() -> int:
       solves-per-dispatch contract;
     * **compile bound** — a SECOND identical fleet call re-enters the
       compiled bucket executables with zero fresh XLA compiles, and the
-      warm LEXIMIN rep stays under ``BENCH_COMPILE_BOUND``.
+      warm LEXIMIN rep stays under ``BENCH_COMPILE_BOUND``;
+    * **device-pricing syncs** — the same tiny face decomposition through
+      the host-oracle path and the device-pricing path: the device path
+      must make STRICTLY FEWER host↔device syncs, its steady-state rounds
+      at most one each, with the device screen actually serving anchors.
 
     Prints one JSON line and returns a process exit code (non-zero on any
     violated invariant), so ``.github/workflows/ci.yml`` can run it right
@@ -824,6 +864,67 @@ def smoke() -> int:
             f"sparse master parity: ELL eps {eps_e:.2e} vs dense {eps_d:.2e}"
         )
 
+    # --- device-pricing host-sync invariants (solvers/device_pricing) ------
+    # the same tiny face decomposition run twice through the forced device-
+    # master route: once with the host anchor MILPs (gate off) and once with
+    # the device pricer + fused screen (gate on). Three asserts, all CI-
+    # cheap: the device path makes STRICTLY FEWER host↔device syncs, its
+    # steady-state rounds stay at ≤ 1 sync each, and the device screen
+    # actually served anchors (otherwise the comparison is vacuous). Both
+    # runs certify the same profile, so the sync win cannot come from
+    # giving up exactness.
+    from citizensassemblies_tpu.core.generator import skewed_instance
+    from citizensassemblies_tpu.solvers.cg_typespace import (
+        CompositionOracle,
+        _leximin_relaxation,
+        _slice_relaxation,
+    )
+    from citizensassemblies_tpu.solvers.face_decompose import realize_profile
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+    dp_dense, _dp_space = featurize(
+        skewed_instance(n=160, k=14, n_categories=4, seed=2)
+    )
+    dp_red = TypeReduction(dp_dense)
+    dp_v, _x = _leximin_relaxation(dp_red, RunLog(echo=False))
+    dp_seeds = _slice_relaxation(
+        dp_v * dp_red.msize.astype(np.float64), dp_red, R=4
+    )
+    dp_counters = {}
+    dp_eps = {}
+    for gate in (False, True):
+        dp_cfg = cfg.replace(
+            decomp_host_master_max_types=0, decomp_device_pricing=gate
+        )
+        dp_log = RunLog(echo=False)
+        _C, _p, eps_run, _s = realize_profile(
+            dp_red, dp_v, list(dp_seeds), CompositionOracle(dp_red, log=dp_log),
+            5e-4, log=dp_log, max_rounds=8, use_pdhg=True, cfg=dp_cfg,
+        )
+        dp_counters[gate] = dp_log.counters
+        dp_eps[gate] = eps_run
+    sync_host = dp_counters[False].get("decomp_host_syncs", 0)
+    sync_dev = dp_counters[True].get("decomp_host_syncs", 0)
+    dev_rounds = dp_counters[True].get("decomp_rounds", 0)
+    dev_steady = sync_dev - dp_counters[True].get("decomp_polish_syncs", 0)
+    if sync_dev >= sync_host:
+        failures.append(
+            f"device pricing made {sync_dev} host syncs vs {sync_host} on the "
+            "host-oracle path (must be strictly fewer)"
+        )
+    if dev_rounds and dev_steady > dev_rounds:
+        failures.append(
+            f"device-pricing steady-state syncs {dev_steady} exceed rounds "
+            f"{dev_rounds} (> 1 per CG round)"
+        )
+    if dp_counters[True].get("decomp_oracle_device_hit", 0) < 1:
+        failures.append("device pricer served no anchors (screen inert)")
+    stalled_bar = max(5e-4, cfg.decomp_accept, cfg.decomp_accept_stalled)
+    if dp_eps[True] > stalled_bar:
+        failures.append(
+            f"device-pricing run failed to certify (eps {dp_eps[True]:.2e})"
+        )
+
     # --- tiny end-to-end parity (engine on vs off) + warm compile bound ----
     dense, space = featurize(random_instance(n=64, k=8, n_categories=2, seed=0))
     d_off = find_distribution_leximin(dense, space, cfg=cfg.replace(lp_batch=False))
@@ -847,6 +948,20 @@ def smoke() -> int:
                 "seconds": round(time.time() - t_start, 1),
                 "parity_linf": round(parity, 9),
                 "sparse_parity_eps": round(sparse_parity, 9),
+                "device_pricing": {
+                    "host_syncs_host_oracle": sync_host,
+                    "host_syncs_device": sync_dev,
+                    "rounds_device": dev_rounds,
+                    "steady_syncs_per_round": (
+                        round(dev_steady / dev_rounds, 2) if dev_rounds else None
+                    ),
+                    "device_hits": dp_counters[True].get(
+                        "decomp_oracle_device_hit", 0
+                    ),
+                    "device_misses": dp_counters[True].get(
+                        "decomp_oracle_device_miss", 0
+                    ),
+                },
                 "e2e_linf": round(e2e, 9),
                 "lp_batch_counters": dict(slog.counters),
                 "warm_fleet_compiles": warm_guard.count,
@@ -971,6 +1086,9 @@ def serve_bench(smoke_mode: bool = False) -> int:
     p50 = lat[len(lat) // 2]
     p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
     host_syncs = sum(int(r.audit.get("decomp_host_syncs", 0)) for r in results)
+    decomp_rounds = sum(
+        int(r.audit.get("counters", {}).get("decomp_rounds", 0)) for r in results
+    )
     row = {
         "metric": "graftserve_mixed_fleet",
         "value": round(serve_s, 2),
@@ -986,6 +1104,10 @@ def serve_bench(smoke_mode: bool = False) -> int:
             "cross_request_batcher": bstats,
             "solves_per_dispatch": round(occupancy, 2),
             "decomp_host_syncs_total": host_syncs,
+            "decomp_rounds_total": decomp_rounds,
+            "decomp_host_syncs_per_round": (
+                round(host_syncs / decomp_rounds, 2) if decomp_rounds else None
+            ),
             "xla_compiles_serve": serve_guard.count,
             "xla_compiles_warm": warm_guard.count,
             "warm_memo_hits": memo_hits,

@@ -447,7 +447,7 @@ def _leximin_impl(
 
     if space is None:
         space = FeatureSpace(categories=(), cells=())
-    oracle = HighsCommitteeOracle(dense, households=households)
+    oracle = HighsCommitteeOracle(dense, households=households, log=log)
     check_feasible_or_suggest(dense, space, oracle, households)
 
     # Fast exact path: type-space (orbit-space) solve. Households do NOT

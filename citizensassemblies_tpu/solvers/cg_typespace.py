@@ -49,7 +49,11 @@ class CompositionOracle:
     ``[0, m_t]``, constraints are ``Σc = k`` plus one row per feature quota.
     """
 
-    def __init__(self, reduction: TypeReduction):
+    def __init__(self, reduction: TypeReduction, log: Optional[RunLog] = None):
+        #: optional RunLog for oracle-mix attribution (every maximize is a
+        #: scipy/HiGHS MILP; the device pricer counts its own lane, so bench
+        #: rows show the native / HiGHS / device split per run)
+        self.log = log
         self.red = reduction
         T, F = reduction.T, reduction.F
         tf = np.zeros((T, F))
@@ -80,6 +84,8 @@ class CompositionOracle:
         exact solve at T ≈ 1000 costs ~0.2 s and the anchors were ~20 % of
         the flagship decomposition wall-clock). Certification calls keep the
         exact default."""
+        if self.log is not None:
+            self.log.count("oracle_backend_highs")
         lo = np.zeros(self.red.T)
         if forced_type is not None:
             lo[forced_type] = 1.0
@@ -816,7 +822,7 @@ def leximin_cg_typespace(
     T = reduction.T
     msize = reduction.msize.astype(np.float64)
     type_id = reduction.type_id
-    oracle = CompositionOracle(reduction)
+    oracle = CompositionOracle(reduction, log=log)
 
     comps: List[np.ndarray] = []
     seen: Dict[bytes, int] = {}

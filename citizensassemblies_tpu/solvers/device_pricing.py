@@ -1,0 +1,455 @@
+"""Device-resident anchor pricing for the face-decomposition loop.
+
+The face loop's anchor oracle prices a bounded integer program over type
+cells: ``max Σ_t w_t c_t`` over compositions ``c ∈ Z^T`` with ``0 ≤ c_t ≤
+m_t``, ``Σ c_t = k`` and per-feature quotas ``qmin ≤ tfᵀ c ≤ qmax`` (the
+type-space collapse of the committee ILP, ``cg_typespace.CompositionOracle``).
+PR 6's ``decomp_host_syncs`` gauge showed that pricing this on the *host*
+(scipy/HiGHS MILP per anchor) keeps the CG round ping-ponging between device
+master solves and host solver calls; ROADMAP item 2 asks for the same
+screen-reduces-host-work move the PR 3 probe prescreen proved sound — a
+device kernel that finds the anchors, with the exact host MILP demoted to a
+certifying fallback it only reaches on a miss.
+
+Two jitted lanes, one dispatch per round for the WHOLE anchor batch
+(dual-direction optimum, alternate-round noisy variants, forced-inclusion
+anchors):
+
+* **β-ladder greedy lanes** (:func:`_get_greedy_core`) — every anchor task
+  fans out into ``_LANES`` deterministic constructive builds, lane ``l``
+  scoring types by ``β_l · ŵ + urgency``: the same log-spaced
+  inverse-temperature ladder the stochastic committee pricer uses
+  (``pricing.beta_ladder``), so low-β lanes are urgency-dominated
+  (feasibility-first, diverse) and high-β lanes are weight-greedy (what
+  finds improving columns when the duals concentrate). One ``lax.scan`` over
+  the k slots builds all lanes at once (vmapped): per step a type is
+  eligible iff its count is below the pool size, every feature it carries
+  stays ≤ its upper quota, and — in any category whose remaining lower-quota
+  deficit equals the remaining slots — it covers a deficit feature (the
+  tightness mask that makes the greedy land inside the quota box whenever it
+  can).
+* **exact small-T DP lane** (:func:`_get_dp_core`) — for single-category
+  reductions every type maps 1:1 to a feature, so the pricing program
+  collapses to ``max Σ w_t c_t`` over per-type bounds with one Σ = k row: an
+  O(T·k²) dynamic program over (type, slots-used) solved by a scan with a
+  backtracking pass, exact over the uploaded (f32) weights —
+  certification-grade anchors in one dispatch, no search.
+
+Both lanes return candidate compositions + device feasibility flags; the
+harvest re-validates every candidate in exact host integer arithmetic before
+it may enter the master (an anchor is a *portfolio column* — the panel
+decomposition later realizes it as actual panels, so feasibility is a hard
+contract, not a heuristic nicety). A task none of whose lanes survive falls
+back to the host MILP: the device screen only ever *reduces* host oracle
+calls, never replaces the exact path, and the stage-CG certification MILPs
+(``cg_typespace``) are untouched — the 1e-3 L∞ exactness audit contract is
+unchanged. Routing is the ``Config.decomp_device_pricing`` tri-state
+(``None`` = auto: on on accelerator backends, off on CPU; off ⇒ the PR 6
+host anchor schedule runs bit-identically).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+from citizensassemblies_tpu.solvers.pricing import beta_ladder
+from citizensassemblies_tpu.utils.config import Config
+from citizensassemblies_tpu.utils.guards import no_implicit_transfers
+from citizensassemblies_tpu.utils.logging import RunLog
+
+_NEG = jnp.float32(-1e30)
+
+#: β-ladder lanes per anchor task. Six spans urgency-dominated (β = 0.1)
+#: through weight-greedy (β ≈ 300) with one compiled program; more lanes cost
+#: nothing on an accelerator but pad the (rare) CPU-forced runs.
+_LANES = 6
+
+#: urgency weight added per deficit feature a type covers, against per-lane
+#: weights normalized to max |ŵ| = 1 then scaled by β — so the boost
+#: dominates the low-β lanes and is noise to the high-β ones, which is the
+#: explore/exploit split the ladder exists to provide
+_URGENCY = 2.0
+
+
+def device_pricing_enabled(cfg: Optional[Config]) -> bool:
+    """Resolve the ``Config.decomp_device_pricing`` tri-state.
+
+    ``True``/``False`` force; ``None`` (auto) engages the device pricer on
+    accelerator backends only — mirroring the master/expand routing, a
+    CPU-only run keeps the host oracle where per-dispatch overhead outweighs
+    the batching. The auto-off CPU default is also what keeps every gate-off
+    code path bit-identical to the pre-device-pricing engine.
+    """
+    knob = getattr(cfg, "decomp_device_pricing", None)
+    if knob is not None:
+        return bool(knob)
+    return jax.default_backend() not in ("cpu",)
+
+
+_GREEDY_CORE = None
+
+
+def _get_greedy_core():
+    """Build (once) the jitted β-ladder greedy constructive core.
+
+    One ``lax.scan`` over the ``k`` slots, vmapped over the lane batch. Per
+    step each lane runs the LEGACY sampler's urgent-cell-first discipline in
+    type space: the most urgent feature cell (highest deficit/remaining-
+    supply ratio, supply counted over currently eligible types) constrains
+    the pick whenever any lower-quota deficit remains, and the pick within
+    the admissible set is the argmax of ``score = β·ŵ + urgency`` — so high-β
+    lanes are weight-greedy wherever the quotas leave freedom and every lane
+    is feasibility-first where they do not. Eligibility also enforces pool
+    bounds, upper quotas, and deficit coverage in any category whose total
+    deficit equals the remaining slots. Integer state only (counts, feature
+    counts), so the device feasibility flag is exact, not a float tolerance.
+    Compiled once per (B, T, F, ncat, k) shape.
+    """
+    global _GREEDY_CORE
+    if _GREEDY_CORE is None:
+
+        @partial(jax.jit, static_argnames=("k",))
+        def core(
+            feat_of, cat_of, tf, msize, qmin, qmax, weights, forced, k: int
+        ):
+            T, ncat = feat_of.shape
+            F = qmin.shape[0]
+
+            def lane(w, f):
+                in_pool = msize > 0
+                seed = (jnp.arange(T, dtype=jnp.int32) == f) & in_pool
+                c0 = seed.astype(jnp.int32)
+                s0 = jnp.zeros(F, jnp.int32).at[feat_of[jnp.maximum(f, 0)]].add(
+                    jnp.where(seed.any(), 1, 0)
+                )
+                used0 = jnp.where(seed.any(), jnp.int32(1), jnp.int32(0))
+                # a forced type outside the pool can never be priced here —
+                # fail the lane so the task routes to the host MILP
+                failed0 = (f >= 0) & ~seed.any()
+
+                def step(state, _):
+                    c, s, used, failed = state
+                    rem = jnp.int32(k) - used
+                    deficit = jnp.maximum(qmin - s, 0)
+                    cat_def = jax.ops.segment_sum(
+                        deficit, cat_of, num_segments=ncat
+                    )
+                    # more lower-quota deficit in one category than slots
+                    # remain: the lane cannot recover
+                    failed = failed | ((rem > 0) & (jnp.max(cat_def) > rem))
+                    tight = cat_def >= rem  # == when it binds (see above)
+                    d_t = deficit[feat_of]  # [T, ncat]
+                    up_ok = jnp.all(s[feat_of] + 1 <= qmax[feat_of], axis=1)
+                    tight_ok = jnp.all(~tight[None, :] | (d_t > 0), axis=1)
+                    eligible = (c < msize) & up_ok & tight_ok
+                    # urgent cell: deficit / remaining supply over ELIGIBLE
+                    # types (the LEGACY ratio, legacy.py:124-157, with the
+                    # starved check riding the supply count)
+                    avail = ((msize - c) * eligible).astype(jnp.float32)
+                    supply = avail @ tf  # [F] units still reachable per cell
+                    starved = (deficit > 0) & (supply < deficit)
+                    failed = failed | ((rem > 0) & starved.any())
+                    urgent = deficit > 0
+                    ratio = jnp.where(
+                        urgent, deficit / jnp.maximum(supply, 1.0), _NEG
+                    )
+                    cell = jnp.argmax(ratio)
+                    in_cell = jnp.any(feat_of == cell, axis=1)
+                    pick_ok = eligible & jnp.where(urgent.any(), in_cell, True)
+                    need = (d_t > 0).sum(axis=1).astype(jnp.float32)
+                    score = w + _URGENCY * need
+                    pick = jnp.argmax(jnp.where(pick_ok, score, _NEG))
+                    active = (rem > 0) & ~failed
+                    failed = failed | (active & ~pick_ok.any())
+                    inc = jnp.where(active & pick_ok.any(), 1, 0)
+                    c = c.at[pick].add(inc)
+                    s = s.at[feat_of[pick]].add(inc)
+                    return (c, s, used + inc, failed), None
+
+                (c, s, used, failed), _ = jax.lax.scan(
+                    step, (c0, s0, used0, failed0), None, length=k
+                )
+                ok = (
+                    ~failed
+                    & (used == k)
+                    & jnp.all(s >= qmin)
+                    & jnp.all(s <= qmax)
+                )
+                return c, ok
+
+            return jax.vmap(lane)(weights, forced)
+
+        _GREEDY_CORE = core
+    return _GREEDY_CORE
+
+
+_DP_CORE = None
+
+
+def _get_dp_core():
+    """Build (once) the jitted exact DP core for single-category reductions.
+
+    With ``ncat == 1`` distinct types carry distinct features, so the quota
+    rows collapse to per-type bounds ``c_t ∈ [qmin_{f_t}, min(m_t,
+    qmax_{f_t})]`` and the program is a bounded exact-knapsack: DP over
+    (type, slots used) with value table ``val[s]`` updated per type by
+    ``val'[s] = max_c val[s−c] + w_t·c`` and the argmax choices recorded for
+    a reverse-scan backtrack. Exact over the uploaded f32 weights — the lane
+    the harvest labels certification-grade. Compiled once per (B, T, k).
+    """
+    global _DP_CORE
+    if _DP_CORE is None:
+
+        @partial(jax.jit, static_argnames=("k",))
+        def core(feat1, msize, qmin, qmax, weights, forced, k: int):
+            T = feat1.shape[0]
+            lo_t = jnp.maximum(qmin[feat1], 0)
+            hi_t = jnp.minimum(msize, qmax[feat1])
+            cand = jnp.arange(k + 1, dtype=jnp.int32)
+
+            def lane(w, f):
+                lo = jnp.where(
+                    jnp.arange(T, dtype=jnp.int32) == f,
+                    jnp.maximum(lo_t, 1), lo_t,
+                )
+
+                def body(val, t_in):
+                    w_t, lo_tt, hi_tt = t_in
+                    s_idx = cand[:, None]
+                    c_idx = cand[None, :]
+                    feas = (c_idx >= lo_tt) & (c_idx <= hi_tt) & (c_idx <= s_idx)
+                    prev = val[jnp.maximum(s_idx - c_idx, 0)]
+                    tot = jnp.where(feas, prev + w_t * c_idx, _NEG)
+                    return jnp.max(tot, axis=1), jnp.argmax(tot, axis=1)
+
+                val0 = jnp.where(cand == 0, jnp.float32(0.0), _NEG)
+                valK, choices = jax.lax.scan(body, val0, (w, lo, hi_t))
+
+                def back(s, t_choice):
+                    # argmax widens to int64 under an enable_x64 trace — pin
+                    # the carry dtype so the scan types stay fixed
+                    c_t = t_choice[s].astype(jnp.int32)
+                    return s - c_t, c_t
+
+                _s, comp = jax.lax.scan(
+                    back, jnp.int32(k), choices, reverse=True
+                )
+                return comp.astype(jnp.int32), valK[k] > _NEG * 0.5
+
+            return jax.vmap(lane)(weights, forced)
+
+        _DP_CORE = core
+    return _DP_CORE
+
+
+@register_ir_core("device_pricing.greedy_lanes")
+def _ir_greedy_lanes() -> IRCase:
+    """The β-ladder greedy pricer at one small (B=8 lanes, T=32 types, F=12
+    features over 3 categories, k=8 slots) shape — integer scan state and the
+    per-step eligibility masks are the structure under verification."""
+    S = jax.ShapeDtypeStruct
+    i32, f32 = jnp.int32, jnp.float32
+    B, T, F, ncat = 8, 32, 12, 3
+    return IRCase(
+        fn=_get_greedy_core(),
+        args=(
+            S((T, ncat), i32), S((F,), i32), S((T, F), f32), S((T,), i32),
+            S((F,), i32), S((F,), i32), S((B, T), f32), S((B,), i32),
+        ),
+        static=dict(k=8),
+    )
+
+
+@register_ir_core("device_pricing.exact_dp")
+def _ir_exact_dp() -> IRCase:
+    """The exact single-category DP at (B=4, T=16, k=8): the value-table
+    scan plus the reverse backtrack scan."""
+    S = jax.ShapeDtypeStruct
+    i32, f32 = jnp.int32, jnp.float32
+    B, T, F = 4, 16, 16
+    return IRCase(
+        fn=_get_dp_core(),
+        args=(
+            S((T,), i32), S((T,), i32), S((F,), i32), S((F,), i32),
+            S((B, T), f32), S((B,), i32),
+        ),
+        static=dict(k=8),
+    )
+
+
+@dataclasses.dataclass
+class PricingHandle:
+    """An in-flight device pricing dispatch: device arrays plus the task
+    list needed to decode them at harvest. ``lanes`` is the per-task fan-out
+    (1 on the exact DP route)."""
+
+    comps: jnp.ndarray  # [B, T] int32 device array
+    ok: jnp.ndarray  # [B] bool device array
+    tasks: List[Tuple[np.ndarray, Optional[int]]]
+    lanes: int
+    exact: bool
+
+
+class DevicePricer:
+    """Host wrapper: device-resident static operands + dispatch/harvest.
+
+    The quota structure (type→feature incidence, pool sizes, quota bounds)
+    uploads ONCE at construction and stays device-resident across every CG
+    round; a dispatch ships only the per-round ``[B, T]`` lane-weight matrix
+    (plus the forced-type vector) and returns immediately with device
+    arrays, so the pricing executes while the caller runs the next master —
+    the same one-round-lagged overlap the host thread pool provided, with
+    the accelerator as the worker. ``harvest`` is where results cross back:
+    every candidate is re-validated in exact host integer arithmetic, the
+    best feasible lane per task becomes that task's anchor, and tasks with
+    no surviving lane are reported as misses for the caller's host-MILP
+    fallback.
+    """
+
+    def __init__(
+        self,
+        reduction: TypeReduction,
+        cfg: Optional[Config] = None,
+        log: Optional[RunLog] = None,
+        lanes: int = _LANES,
+    ):
+        self.red = reduction
+        self.cfg = cfg
+        self.log = log
+        self.lanes = int(lanes)
+        self.exact = reduction.n_cats == 1
+        feat_of = np.asarray(reduction.type_feature, dtype=np.int32)
+        # feature → category map (features are one-hot per category, so each
+        # feature index appears in exactly one column of type_feature)
+        cat_of = np.zeros(reduction.F, dtype=np.int32)
+        for ci in range(reduction.n_cats):
+            cat_of[np.unique(feat_of[:, ci])] = ci
+        self._feat_of = jnp.asarray(feat_of)
+        self._cat_of = jnp.asarray(cat_of)
+        tf32 = np.zeros((reduction.T, reduction.F), dtype=np.float32)
+        if reduction.n_cats:
+            tf32[
+                np.repeat(np.arange(reduction.T), reduction.n_cats),
+                feat_of.ravel(),
+            ] = 1.0
+        self._tf_dev = jnp.asarray(tf32)
+        self._msize = jnp.asarray(reduction.msize.astype(np.int32))
+        self._qmin = jnp.asarray(reduction.qmin.astype(np.int32))
+        self._qmax = jnp.asarray(reduction.qmax.astype(np.int32))
+        # host-side exact validation operands (int64 — no float tolerance)
+        self._tf = np.zeros((reduction.T, reduction.F), dtype=np.int64)
+        if reduction.n_cats:
+            self._tf[
+                np.repeat(np.arange(reduction.T), reduction.n_cats),
+                feat_of.ravel(),
+            ] = 1
+
+    def dispatch(
+        self, tasks: Sequence[Tuple[np.ndarray, Optional[int]]]
+    ) -> Optional[PricingHandle]:
+        """Price the whole anchor batch in one device dispatch (async).
+
+        ``tasks`` are ``(weights float64[T], forced_type or None)`` exactly
+        as the host oracle consumes them. Weights are normalized per task
+        (argmax-invariant; values are recomputed in float64 at harvest) and
+        fanned out over the β ladder on the greedy route; the exact DP route
+        prices each task once.
+        """
+        if not tasks:
+            return None
+        W = np.stack([np.asarray(w, dtype=np.float64) for w, _f in tasks])
+        W = W / (np.abs(W).max(axis=1, keepdims=True) + 1e-12)
+        forced_np = np.array(
+            [(-1 if f is None else int(f)) for _w, f in tasks], dtype=np.int32
+        )
+        if self.exact:
+            lanes = 1
+            lane_w = W.astype(np.float32)
+            lane_f = forced_np
+            core = _get_dp_core()
+            operands = (
+                jnp.asarray(self._feat_of[:, 0]), self._msize,
+                self._qmin, self._qmax,
+                jnp.asarray(lane_w), jnp.asarray(lane_f),
+            )
+        else:
+            lanes = self.lanes
+            betas = beta_ladder(lanes)  # the pricing.py steering ladder
+            lane_w = (betas[None, :, None] * W[:, None, :]).reshape(
+                len(tasks) * lanes, -1
+            ).astype(np.float32)
+            lane_f = np.repeat(forced_np, lanes)
+            core = _get_greedy_core()
+            operands = (
+                self._feat_of, self._cat_of, self._tf_dev, self._msize,
+                self._qmin, self._qmax,
+                jnp.asarray(lane_w), jnp.asarray(lane_f),
+            )
+        with no_implicit_transfers(self.cfg):
+            comps, ok = core(*operands, k=int(self.red.k))
+        return PricingHandle(
+            comps=comps, ok=ok, tasks=list(tasks), lanes=lanes, exact=self.exact
+        )
+
+    def _validate(self, comps: np.ndarray, ok: np.ndarray) -> np.ndarray:
+        """Exact host integer re-validation of every candidate lane: the
+        device flag is integer math and should agree, but an anchor becomes
+        a portfolio column the panel decomposition later realizes as actual
+        panels — feasibility is a hard contract, so it is re-proven in int64
+        on host before a column may enter the master."""
+        red = self.red
+        counts = comps.astype(np.int64) @ self._tf
+        feas = np.asarray(ok, dtype=bool).copy()
+        feas &= comps.sum(axis=1) == red.k
+        feas &= (comps >= 0).all(axis=1)
+        feas &= (comps <= red.msize[None, :]).all(axis=1)
+        feas &= (counts >= red.qmin[None, :]).all(axis=1)
+        feas &= (counts <= red.qmax[None, :]).all(axis=1)
+        return feas
+
+    def harvest(
+        self, handle: PricingHandle
+    ) -> Tuple[List[Tuple[int, np.ndarray]], List[int]]:
+        """Read the dispatch back and decode per task.
+
+        Returns ``(hits, missed)``: ``hits`` as ``(task_index, composition
+        int16 [1, T])`` pairs — the best surviving lane per task by exact
+        float64 value — and ``missed`` as the task indices with no surviving
+        lane (the caller's host-MILP fallback set). In the steady-state
+        round the device work completed while the master solved, so this
+        readback does not block on in-flight compute.
+        """
+        comps = np.asarray(handle.comps)
+        ok = np.asarray(handle.ok)
+        feas = self._validate(comps, ok)
+        if self.log is not None and int((np.asarray(ok) & ~feas).sum()):
+            # device said feasible, exact host arithmetic disagreed — should
+            # never happen (integer state both sides); surfaced, not hidden
+            self.log.count(
+                "decomp_oracle_device_invalid",
+                int((np.asarray(ok) & ~feas).sum()),
+            )
+        hits: List[Tuple[int, np.ndarray]] = []
+        missed: List[int] = []
+        L = handle.lanes
+        for i, (w, f) in enumerate(handle.tasks):
+            sl = slice(i * L, (i + 1) * L)
+            lane_feas = feas[sl]
+            if f is not None:
+                lane_feas = lane_feas & (comps[sl, int(f)] >= 1)
+            if not lane_feas.any():
+                missed.append(i)
+                continue
+            vals = comps[sl].astype(np.float64) @ np.asarray(w, np.float64)
+            vals = np.where(lane_feas, vals, -np.inf)
+            best = int(np.argmax(vals))
+            hits.append((i, comps[sl][best][None, :].astype(np.int16)))
+        return hits, missed

@@ -36,9 +36,16 @@ MILPs run on a worker thread double-buffered against the device master
 serial fallback is bit-identical), the master's and polish's PDHG iterates
 carry across rounds, prunes and column-bucket growths with a stall-triggered
 cold restart (``_WarmStall``), and the per-round move screen can run as one
-jitted device batch (``_batched_move_screen``). All of it is wall-clock
-machinery — acceptance remains the float64 arithmetic residual of whatever
-mixture comes back.
+jitted device batch (``_batched_move_screen``). Behind the
+``Config.decomp_device_pricing`` gate the engine goes *device-resident*: the
+anchor batch prices in one jitted dispatch (``solvers/device_pricing``, the
+exact host MILP demoted to a per-task fallback), and the move screen's pair
+selection moves on device so the screen chains onto the master's device
+duals (``_FusedScreen``) — a steady-state round then makes exactly one
+host↔device synchronization, measured by the ``decomp_host_syncs`` /
+``decomp_rounds`` gauge pair. All of it is wall-clock machinery —
+acceptance remains the float64 arithmetic residual of whatever mixture
+comes back.
 """
 
 from __future__ import annotations
@@ -84,6 +91,44 @@ def _feature_bitmasks(reduction: TypeReduction):
     return masks, leftover
 
 
+def _screen_feasible(
+    comps_i, counts_nb, lo_nb, hi_nb, counts_full, lo_f, hi_f,
+    m_t, ti, tj, valid, ns_lo, ns_hi, na_lo, na_hi, lf_ai, lf_aj, lf_donor,
+):
+    """The [S, P] (composition, move) feasibility check shared by the two
+    jitted screen cores: base bounds via two device gathers, per-feature
+    quota conditions via the packed uint32 bitword lanes, leftover (>word)
+    categories via direct gathers. Traced code — callers are jitted."""
+    import jax.numpy as jnp
+
+    ci = comps_i[:, ti]  # [Sp, Pp] gathers (padding rows are zero)
+    cj = comps_i[:, tj]
+    ok = (ci > 0) & (cj < m_t[tj][None, :]) & valid[None, :]
+    bits32 = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+
+    def pack(bits):  # bool [Sp, 64] → (lo, hi) uint32 words [Sp]
+        b = bits.astype(jnp.uint32)
+        return (
+            (b[:, :32] * bits32).sum(axis=1),
+            (b[:, 32:] * bits32).sum(axis=1),
+        )
+
+    cs_lo, cs_hi = pack(counts_nb - 1 >= lo_nb[None, :])
+    ca_lo, ca_hi = pack(counts_nb + 1 <= hi_nb[None, :])
+    ok &= (ns_lo[None, :] & ~cs_lo[:, None]) == 0
+    ok &= (ns_hi[None, :] & ~cs_hi[:, None]) == 0
+    ok &= (na_lo[None, :] & ~ca_lo[:, None]) == 0
+    ok &= (na_hi[None, :] & ~ca_hi[:, None]) == 0
+    for l in range(lf_ai.shape[0]):  # static leftover-category count
+        ai, aj = lf_ai[l], lf_aj[l]
+        same = ai == aj
+        add_ok = counts_full[:, aj] + 1 <= hi_f[aj][None, :]
+        sub_ok = counts_full[:, ai] - 1 >= lo_f[ai][None, :]
+        add_ok &= jnp.where(lf_donor[l], sub_ok, True)
+        ok &= same[None, :] | add_ok
+    return ok
+
+
 _MOVE_SCREEN_CORE = None
 
 
@@ -115,37 +160,107 @@ def _get_move_screen_core():
             m_t, ti, tj, valid, ns_lo, ns_hi, na_lo, na_hi,
             lf_ai, lf_aj, lf_donor, cap: int,
         ):
-            ci = comps_i[:, ti]  # [Sp, Pp] gathers (padding rows are zero)
-            cj = comps_i[:, tj]
-            ok = (ci > 0) & (cj < m_t[tj][None, :]) & valid[None, :]
-            bits32 = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
-
-            def pack(bits):  # bool [Sp, 64] → (lo, hi) uint32 words [Sp]
-                b = bits.astype(jnp.uint32)
-                return (
-                    (b[:, :32] * bits32).sum(axis=1),
-                    (b[:, 32:] * bits32).sum(axis=1),
-                )
-
-            cs_lo, cs_hi = pack(counts_nb - 1 >= lo_nb[None, :])
-            ca_lo, ca_hi = pack(counts_nb + 1 <= hi_nb[None, :])
-            ok &= (ns_lo[None, :] & ~cs_lo[:, None]) == 0
-            ok &= (ns_hi[None, :] & ~cs_hi[:, None]) == 0
-            ok &= (na_lo[None, :] & ~ca_lo[:, None]) == 0
-            ok &= (na_hi[None, :] & ~ca_hi[:, None]) == 0
-            for l in range(lf_ai.shape[0]):  # static leftover-category count
-                ai, aj = lf_ai[l], lf_aj[l]
-                same = ai == aj
-                add_ok = counts_full[:, aj] + 1 <= hi_f[aj][None, :]
-                sub_ok = counts_full[:, ai] - 1 >= lo_f[ai][None, :]
-                add_ok &= jnp.where(lf_donor[l], sub_ok, True)
-                ok &= same[None, :] | add_ok
+            ok = _screen_feasible(
+                comps_i, counts_nb, lo_nb, hi_nb, counts_full, lo_f, hi_f,
+                m_t, ti, tj, valid, ns_lo, ns_hi, na_lo, na_hi,
+                lf_ai, lf_aj, lf_donor,
+            )
             flat = ok.reshape(-1)
             (idx,) = jnp.nonzero(flat, size=cap, fill_value=-1)
             return idx.astype(jnp.int32), flat.sum(dtype=jnp.int32)
 
         _MOVE_SCREEN_CORE = core
     return _MOVE_SCREEN_CORE
+
+
+_FUSED_SCREEN_CORE = None
+
+
+def _get_fused_screen_core():
+    """Build (once) the jitted FUSED move screen of the device-pricing round.
+
+    The classic screen needs the master's duals on host before it can even
+    be marshalled (pair selection is a numpy argsort over ``r_norm``), which
+    costs the round a second host↔device round trip. This core moves the
+    pair selection on device so the whole screen chains onto the master's
+    DEVICE dual output with no host involvement: ``r_norm = −w/m`` from the
+    raw ``lam`` vector, improving pairs as a ``top_k`` meshgrid of the
+    residual extremes, face pairs as the smallest-|Δ| ``top_k`` over a
+    static per-instance candidate pool, need-masks gathered from the
+    device-resident uint32 lanes, then the shared feasibility body. Returns
+    the selected (ti, tj) alongside the feasible indices because the host
+    never saw the pairs. The pair count is static (pool_cap² + face_pairs),
+    so one program per (S, T, F, leftover) shape serves every round.
+    """
+    global _FUSED_SCREEN_CORE
+    if _FUSED_SCREEN_CORE is None:
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("cap", "pool_cap", "face_pairs"))
+        def core(
+            lam, m_f, comps_i, counts_nb, lo_nb, hi_nb, counts_full,
+            lo_f, hi_f, m_t, mask_lo, mask_hi, cand_di, cand_dj,
+            lf_feat, lf_donor, cap: int, pool_cap: int, face_pairs: int,
+        ):
+            T = m_f.shape[0]
+            w = lam[:T] - lam[T:]
+            r = -w / m_f
+            _, donors = jax.lax.top_k(r, pool_cap)
+            _, receivers = jax.lax.top_k(-r, pool_cap)
+            delta = jnp.abs(r[cand_di] - r[cand_dj])
+            _, sel = jax.lax.top_k(-delta, face_pairs)
+            ti = jnp.concatenate(
+                [jnp.repeat(donors, pool_cap), cand_di[sel]]
+            ).astype(jnp.int32)
+            tj = jnp.concatenate(
+                [jnp.tile(receivers, pool_cap), cand_dj[sel]]
+            ).astype(jnp.int32)
+            valid = ti != tj
+            dl = mask_lo[ti] ^ mask_lo[tj]
+            dh = mask_hi[ti] ^ mask_hi[tj]
+            ns_lo, ns_hi = mask_lo[ti] & dl, mask_hi[ti] & dh
+            na_lo, na_hi = mask_lo[tj] & dl, mask_hi[tj] & dh
+            lf_ai = lf_feat[:, ti]  # [L, P] leftover-category features
+            lf_aj = lf_feat[:, tj]
+            ok = _screen_feasible(
+                comps_i, counts_nb, lo_nb, hi_nb, counts_full, lo_f, hi_f,
+                m_t, ti, tj, valid, ns_lo, ns_hi, na_lo, na_hi,
+                lf_ai, lf_aj, lf_donor,
+            )
+            flat = ok.reshape(-1)
+            (idx,) = jnp.nonzero(flat, size=cap, fill_value=-1)
+            return idx.astype(jnp.int32), flat.sum(dtype=jnp.int32), ti, tj
+
+        _FUSED_SCREEN_CORE = core
+    return _FUSED_SCREEN_CORE
+
+
+@register_ir_core("face_decompose.fused_screen")
+def _ir_fused_screen() -> IRCase:
+    """The fused (pair-selection-on-device) move screen at a small
+    (T=32, F=40, one leftover category) shape — the top_k pair selection
+    chained ahead of the shared bitmask feasibility body."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    i32, u32, f32 = jnp.int32, jnp.uint32, jnp.float32
+    T, F, Q, L = 32, 40, 1024, 1
+    return IRCase(
+        fn=_get_fused_screen_core(),
+        args=(
+            S((2 * T,), f32), S((T,), f32),
+            S((_SCREEN_ROWS, T), i32), S((_SCREEN_ROWS, 64), i32),
+            S((64,), i32), S((64,), i32), S((_SCREEN_ROWS, F), i32),
+            S((F,), i32), S((F,), i32), S((T,), i32),
+            S((T,), u32), S((T,), u32), S((Q,), i32), S((Q,), i32),
+            S((L, T), i32), S((L,), jnp.bool_),
+        ),
+        static=dict(cap=1024, pool_cap=8, face_pairs=64),
+    )
 
 
 @register_ir_core("face_decompose.move_screen")
@@ -184,6 +299,56 @@ _SCREEN_ROWS = 512
 _POLISH_SCREEN_MIN_SUP = 256
 
 
+def _move_pairs(
+    reduction: TypeReduction,
+    r_norm: np.ndarray,
+    pool_cap: int,
+    face_pairs: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The expansion's candidate (donor, receiver) pair selection — the
+    improving extremes of the residual direction plus the smallest-|Δ| face
+    pairs. Factored out of :func:`neighbor_columns` so the fused device
+    screen's on-device pair selection (:func:`_get_fused_screen_core`) has
+    one host reference to mirror. Returns ``(ti, tj)``."""
+    T = reduction.T
+    order = np.argsort(-r_norm)
+    # improving pairs: extremes of the residual direction
+    donors = order[:pool_cap]
+    receivers = order[::-1][:pool_cap]
+    ti_a, tj_a = np.meshgrid(donors, receivers, indexing="ij")
+    pairs = [np.stack([ti_a.ravel(), tj_a.ravel()], axis=1)]
+    # face pairs: smallest |Δ| over a broad random pool (full T² only for
+    # small T)
+    if T * T <= 1 << 18:
+        di = np.repeat(np.arange(T), T)
+        dj = np.tile(np.arange(T), T)
+    else:
+        rng = np.random.default_rng(T)
+        di = rng.integers(0, T, size=face_pairs * 8)
+        dj = rng.integers(0, T, size=face_pairs * 8)
+    delta = np.abs(r_norm[di] - r_norm[dj])
+    sel = np.argsort(delta)[:face_pairs]
+    pairs.append(np.stack([di[sel], dj[sel]], axis=1))
+    tp = np.concatenate(pairs, axis=0)
+    tp = tp[tp[:, 0] != tp[:, 1]]
+    tp = np.unique(tp, axis=0)
+    return tp[:, 0], tp[:, 1]
+
+
+def _comp_feature_counts(comps: np.ndarray, reduction: TypeReduction) -> np.ndarray:
+    """Per-composition feature counts [S, F]: float32 BLAS then cast — numpy
+    integer matmuls bypass BLAS, and at quotient scale ([512, 1199] @
+    [1199, 626]) the int64 product alone cost ~0.4 s per face round;
+    counts ≤ k ≤ a few hundred, far inside float32's exact-integer range."""
+    T = reduction.T
+    feat_of = np.asarray(reduction.type_feature)
+    ncat = feat_of.shape[1]
+    F = reduction.F
+    tf = np.zeros((T, F), dtype=np.float32)
+    tf[np.repeat(np.arange(T), ncat), feat_of.ravel()] = 1.0
+    return (comps.astype(np.float32) @ tf).astype(np.int64)
+
+
 def _batched_move_screen(
     comps: np.ndarray,
     counts: np.ndarray,
@@ -198,6 +363,31 @@ def _batched_move_screen(
     """Host marshalling for the jitted move screen: pad to the screening
     buckets, split the uint64 need-masks into uint32 lanes, decode the
     returned flat indices. Returns ``(si, pi, total_feasible)``."""
+    idx_dev, _total_dev, Pp = _move_screen_dispatch(
+        comps, counts, reduction, m, ti, tj, packed, per_round_cap, cfg=cfg
+    )
+    idx = np.asarray(idx_dev)
+    idx = idx[idx >= 0]
+    return idx // Pp, idx % Pp, int(_total_dev)
+
+
+def _move_screen_dispatch(
+    comps: np.ndarray,
+    counts: np.ndarray,
+    reduction: TypeReduction,
+    m: np.ndarray,
+    ti: np.ndarray,
+    tj: np.ndarray,
+    packed,
+    per_round_cap: int,
+    cfg=None,
+):
+    """The marshalling + async device dispatch half of the move screen:
+    everything up to (but not including) the blocking result readback, so a
+    caller can overlap the screen with other device work and harvest later
+    (the lagged round of the device-pricing mode). Returns
+    ``(idx device-array, total device-array, Pp)`` — decode with
+    ``np.asarray`` exactly as :func:`_batched_move_screen` does."""
     masks, leftover = packed
     S, T = comps.shape
     F = reduction.F
@@ -262,9 +452,7 @@ def _batched_move_screen(
     )
     with no_implicit_transfers(cfg):
         idx, total = core(*operands, cap=int(per_round_cap))
-    idx = np.asarray(idx)
-    idx = idx[idx >= 0]
-    return idx // Pp, idx % Pp, int(total)
+    return idx, total, Pp
 
 
 def neighbor_columns(
@@ -309,6 +497,7 @@ def neighbor_columns(
     S, T = comps.shape
     feat_of = np.asarray(reduction.type_feature)  # [T, ncat]
     ncat = feat_of.shape[1]
+    F = reduction.F
     # clip before the int16 cast: composition entries are <= k (small), but
     # a pool type can exceed int16 range — the receiver check only needs
     # min(m, k+1), since no composition holds more than k of any type
@@ -316,40 +505,12 @@ def neighbor_columns(
     lo = reduction.qmin.astype(np.int64)
     hi = reduction.qmax.astype(np.int64)
 
-    order = np.argsort(-r_norm)
-    # improving pairs: extremes of the residual direction
-    donors = order[:pool_cap]
-    receivers = order[::-1][:pool_cap]
-    ti_a, tj_a = np.meshgrid(donors, receivers, indexing="ij")
-    pairs = [np.stack([ti_a.ravel(), tj_a.ravel()], axis=1)]
-    # face pairs: smallest |Δ| over a broad random pool (full T² only for
-    # small T)
-    if T * T <= 1 << 18:
-        di = np.repeat(np.arange(T), T)
-        dj = np.tile(np.arange(T), T)
-    else:
-        rng = np.random.default_rng(T)
-        di = rng.integers(0, T, size=face_pairs * 8)
-        dj = rng.integers(0, T, size=face_pairs * 8)
-    delta = np.abs(r_norm[di] - r_norm[dj])
-    sel = np.argsort(delta)[:face_pairs]
-    pairs.append(np.stack([di[sel], dj[sel]], axis=1))
-    tp = np.concatenate(pairs, axis=0)
-    tp = tp[tp[:, 0] != tp[:, 1]]
-    tp = np.unique(tp, axis=0)
-    ti, tj = tp[:, 0], tp[:, 1]
+    ti, tj = _move_pairs(reduction, r_norm, pool_cap, face_pairs)
     P = len(ti)
     if P == 0:
         return np.zeros((0, T), dtype=np.int16)
 
-    # per-composition feature counts [S, F]: float32 BLAS then cast — numpy
-    # integer matmuls bypass BLAS, and at quotient scale ([512, 1199] @
-    # [1199, 626]) the int64 product alone cost ~0.4 s per face round;
-    # counts ≤ k ≤ a few hundred, far inside float32's exact-integer range
-    F = reduction.F
-    tf = np.zeros((T, F), dtype=np.float32)
-    tf[np.repeat(np.arange(T), ncat), feat_of.ravel()] = 1.0
-    counts = (comps.astype(np.float32) @ tf).astype(np.int64)  # [S, F]
+    counts = _comp_feature_counts(comps, reduction)  # [S, F]
 
     packed = _feature_bitmasks(reduction)
     if batched and packed is not None and S <= _SCREEN_ROWS:
@@ -419,6 +580,145 @@ def neighbor_columns(
     return out
 
 
+class _FusedScreen:
+    """Same-round device move screen chained onto the master's device duals.
+
+    The classic round blocks on the master's readback just to marshal the
+    move screen (pair selection is a host argsort over the duals), then
+    blocks AGAIN on the screen's own result — two host↔device round trips
+    per round. Here the pair selection runs on device
+    (``_get_fused_screen_core``): ``dispatch`` is called with the master's
+    raw ``lam`` still on device, enqueues the screen behind the solve with
+    no host involvement, and the single blocking readback of the round
+    (the master's ``finish``) leaves the screen results already complete —
+    ``harvest`` then decodes them without waiting on in-flight compute. The
+    screened composition block is the round's master columns (mass-ordered
+    prefix from the previous prune), known before the master returns; the
+    pairs come from the CURRENT duals, so the expansion aim is exactly as
+    fresh as the classic path's. Gate-on only — the classic screen and its
+    numpy twin are untouched.
+    """
+
+    def __init__(self, reduction: TypeReduction, per_round_cap: int, cfg=None):
+        import jax.numpy as jnp
+
+        self.red = reduction
+        self.cap = int(per_round_cap)
+        self.cfg = cfg
+        packed = _feature_bitmasks(reduction)
+        self.ok = packed is not None
+        self._pending = None  # (idx_dev, ti_dev, tj_dev, comps) or None
+        if not self.ok:  # pragma: no cover - every instance has a word cat
+            return
+        masks, leftover = packed
+        T, F = reduction.T, reduction.F
+        lo = reduction.qmin.astype(np.int64)
+        hi = reduction.qmax.astype(np.int64)
+        feat_of = np.asarray(reduction.type_feature)
+        word = np.uint64(0xFFFFFFFF)
+        # device-resident static operands: uploaded once per instance
+        self._mask_lo = jnp.asarray((masks & word).astype(np.uint32))
+        self._mask_hi = jnp.asarray((masks >> np.uint64(32)).astype(np.uint32))
+        lf = (
+            np.stack([feat_of[:, ci] for ci in leftover])
+            if leftover else np.zeros((0, T), np.int64)
+        )
+        self._lf_feat = jnp.asarray(lf.astype(np.int32))
+        self._lf_donor = jnp.asarray(
+            np.array(
+                [bool((lo[feat_of[:, ci]] > 0).any()) for ci in leftover],
+                dtype=bool,
+            )
+        )
+        # static face-pair candidate pool (same construction as _move_pairs:
+        # full T² when small, a T-seeded random pool otherwise)
+        if T * T <= 1 << 18:
+            di = np.repeat(np.arange(T), T)
+            dj = np.tile(np.arange(T), T)
+        else:
+            rng = np.random.default_rng(T)
+            di = rng.integers(0, T, size=12_288 * 8)
+            dj = rng.integers(0, T, size=12_288 * 8)
+        self._cand_di = jnp.asarray(di.astype(np.int32))
+        self._cand_dj = jnp.asarray(dj.astype(np.int32))
+        self.pool_cap = min(128, T)
+        self.face_pairs = min(12_288, len(di))
+        nb = min(F, 64)
+        lo_nb = np.full(64, -(1 << 30), np.int32)
+        hi_nb = np.full(64, 1 << 30, np.int32)
+        lo_nb[:nb] = lo[:nb]
+        hi_nb[:nb] = hi[:nb]
+        self._lo_nb = jnp.asarray(lo_nb)
+        self._hi_nb = jnp.asarray(hi_nb)
+        self._lo_f = jnp.asarray(lo.astype(np.int32))
+        self._hi_f = jnp.asarray(hi.astype(np.int32))
+        self._m_t = jnp.asarray(
+            np.minimum(reduction.msize, reduction.k + 1).astype(np.int32)
+        )
+        self._m_f = jnp.asarray(reduction.msize.astype(np.float32))
+
+    @property
+    def pending(self) -> bool:
+        return self._pending is not None
+
+    def dispatch(self, comps: np.ndarray, lam_dev) -> bool:
+        """Enqueue the screen behind the in-flight master whose raw device
+        ``lam`` output is ``lam_dev`` (async — no readback here)."""
+        if not self.ok or len(comps) > _SCREEN_ROWS:  # pragma: no cover
+            self._pending = None
+            return False
+        import jax.numpy as jnp
+
+        red = self.red
+        comps = comps.astype(np.int16, copy=False)
+        S, T = comps.shape
+        counts = _comp_feature_counts(comps, red)
+        F = red.F
+        nb = min(F, 64)
+        comps_p = np.zeros((_SCREEN_ROWS, T), np.int32)
+        comps_p[:S] = comps
+        counts_full = np.zeros((_SCREEN_ROWS, F), np.int32)
+        counts_full[:S] = counts
+        counts_nb = np.zeros((_SCREEN_ROWS, 64), np.int32)
+        counts_nb[:, :nb] = counts_full[:, :nb]
+        core = _get_fused_screen_core()
+        operands = (
+            lam_dev, self._m_f, jnp.asarray(comps_p), jnp.asarray(counts_nb),
+            self._lo_nb, self._hi_nb, jnp.asarray(counts_full),
+            self._lo_f, self._hi_f, self._m_t,
+            self._mask_lo, self._mask_hi, self._cand_di, self._cand_dj,
+            self._lf_feat, self._lf_donor,
+        )
+        with no_implicit_transfers(self.cfg):
+            idx, _total, ti, tj = core(
+                *operands, cap=self.cap, pool_cap=self.pool_cap,
+                face_pairs=self.face_pairs,
+            )
+        self._pending = (idx, ti, tj, comps)
+        return True
+
+    def harvest(self) -> np.ndarray:
+        """Decode the screen results (already complete by the time the
+        master's readback returned) into new compositions int16 [N, T]."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return np.zeros((0, self.red.T), dtype=np.int16)
+        idx_dev, ti_dev, tj_dev, comps = pending
+        idx = np.asarray(idx_dev)
+        ti = np.asarray(ti_dev)
+        tj = np.asarray(tj_dev)
+        idx = idx[idx >= 0]
+        if len(idx) == 0:
+            return np.zeros((0, self.red.T), dtype=np.int16)
+        P = len(ti)
+        si, pi = idx // P, idx % P
+        out = comps[si].astype(np.int16)
+        rows = np.arange(len(si))
+        out[rows, ti[pi]] -= 1
+        out[rows, tj[pi]] += 1
+        return out
+
+
 def _master_pdhg(
     MT: np.ndarray,
     v: np.ndarray,
@@ -427,6 +727,7 @@ def _master_pdhg(
     max_iters: int,
     tol: float,
     ell=None,
+    screen=None,
 ) -> Tuple[float, np.ndarray, np.ndarray, float, Optional[tuple], bool]:
     """One approximate master solve on device: the two-sided ε-LP handed to
     the STRUCTURED warm-started PDHG core (``lp_pdhg.solve_two_sided_master``
@@ -438,6 +739,12 @@ def _master_pdhg(
     the tunnel ships only the NEW columns' packed indices/values since the
     last round, and every PDHG matvec is O(C·k_pad) gather/scatter work.
 
+    ``screen`` (device-pricing mode) is a callback receiving the master's
+    raw DEVICE dual vector the moment the solve is enqueued: the fused move
+    screen it dispatches runs behind the solve with no host involvement, so
+    the blocking readback below stays the round's only synchronization
+    point.
+
     Returns ``(eps_realized, w, p_norm, eps_obj, warm', ok)`` where
     ``eps_realized = ‖M p_norm − v‖∞`` is the *arithmetic* certificate of the
     normalized primal iterate (valid regardless of solver convergence),
@@ -447,19 +754,27 @@ def _master_pdhg(
     compiles once per bucket (same idiom as ``solve_stage_lp_pdhg``).
     """
     from citizensassemblies_tpu.solvers.lp_pdhg import (
-        solve_two_sided_master,
-        solve_two_sided_master_ell,
+        finish_two_sided_master,
+        solve_two_sided_master_async,
+        solve_two_sided_master_ell_async,
     )
 
     T, C = MT.shape
     if ell is not None:
-        sol = solve_two_sided_master_ell(
+        handle = solve_two_sided_master_ell_async(
             ell, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters
         )
     else:
-        sol = solve_two_sided_master(
+        handle = solve_two_sided_master_async(
             MT, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters
         )
+    if screen is not None:
+        # chain the fused move screen onto the master's DEVICE dual output:
+        # it enqueues behind the solve with no host involvement, so the
+        # round's only host↔device synchronization point is the readback in
+        # finish_two_sided_master below (the device-pricing round contract)
+        screen(handle.lam)
+    sol = finish_two_sided_master(handle)
     p = np.maximum(sol.x[:C], 0.0)
     total = p.sum()
     if not np.isfinite(total) or total <= 0.0:
@@ -496,6 +811,18 @@ class _AnchorPricer:
     regression contract (``tests/test_face_decompose.py``). All randomness
     (the noisy-anchor perturbations) is drawn on the caller's thread at
     submit time, so the schedule is deterministic either way.
+
+    With ``device`` set (``solvers/device_pricing.DevicePricer``, behind the
+    ``Config.decomp_device_pricing`` gate) the worker is the ACCELERATOR
+    instead of a host thread: ``submit`` prices the whole task batch in one
+    async device dispatch (β-ladder greedy lanes, or the exact DP lane on
+    single-category reductions) and ``harvest`` decodes it — tasks the
+    device served skip their host MILP entirely
+    (``decomp_oracle_device_hit``), tasks with no surviving lane fall back
+    to the exact host MILP (``decomp_oracle_device_miss``): the device
+    screen only ever REDUCES host oracle calls, it never replaces the exact
+    path. The task schedule — forced-inclusion routing, alternate-round
+    noisy variants, the one-round lag — is identical to the host modes.
     """
 
     def __init__(
@@ -505,17 +832,19 @@ class _AnchorPricer:
         reduction: TypeReduction,
         overlap: bool,
         log: Optional[RunLog] = None,
+        device=None,
     ):
         self.oracle = oracle
         self.rng = rng
         self.red = reduction
         self.log = log
+        self.device = device
         self._pool = (
             ThreadPoolExecutor(max_workers=1, thread_name_prefix="anchor-pricer")
-            if overlap
+            if overlap and device is None
             else None
         )
-        self._pending: Optional[Union[Future, List[np.ndarray]]] = None
+        self._pending: Optional[Union[Future, List[np.ndarray], tuple]] = None
 
     def _run(self, tasks) -> List[np.ndarray]:
         out = []
@@ -558,10 +887,33 @@ class _AnchorPricer:
             for t in worst:
                 if deficit[t] > 0.25 * eps and self.red.msize[t] > 0:
                     tasks.append((-r_norm, int(t)))
-        if self._pool is not None:
+        if self.device is not None:
+            # the accelerator is the worker: one async dispatch prices the
+            # whole batch; the handle is decoded at the next harvest
+            self._pending = ("device", self.device.dispatch(tasks), tasks)
+        elif self._pool is not None:
             self._pending = self._pool.submit(self._run, tasks)
         else:
             self._pending = self._run(tasks)
+
+    def _harvest_device(self, handle, tasks) -> List[np.ndarray]:
+        """Decode a device pricing dispatch: device-served tasks in task
+        order, then the host-MILP results for the misses (the fallback runs
+        inline — misses are the exception, and by harvest time the pipeline
+        has no thread to hide them behind)."""
+        if handle is None:
+            return []
+        hits, missed = self.device.harvest(handle)
+        if self.log is not None:
+            if hits:
+                self.log.count("decomp_oracle_device_hit", len(hits))
+                self.log.count("oracle_backend_device", len(hits))
+            if missed:
+                self.log.count("decomp_oracle_device_miss", len(missed))
+        out = [comp for _i, comp in hits]
+        if missed:
+            out.extend(self._run([tasks[i] for i in missed]))
+        return out
 
     def harvest(self) -> List[np.ndarray]:
         """Collect the previously submitted round's columns (blocks only when
@@ -571,6 +923,8 @@ class _AnchorPricer:
         pending, self._pending = self._pending, None
         if pending is None:
             return []
+        if isinstance(pending, tuple) and pending and pending[0] == "device":
+            return self._harvest_device(pending[1], pending[2])
         if isinstance(pending, list):
             if self.log is not None:
                 self.log.count("decomp_oracle_inline")
@@ -838,6 +1192,7 @@ def realize_profile(
                         max_iters=24_576, cfg=cfg, log=log,
                     )
                 log.count("decomp_host_syncs")
+                log.count("decomp_polish_syncs")  # end-game, not steady-state
             else:
                 insts = []
                 for c_ in caps:
@@ -858,6 +1213,7 @@ def realize_profile(
                         max_iters=24_576, common_bucket=True,
                     )
                 log.count("decomp_host_syncs")
+                log.count("decomp_polish_syncs")  # end-game, not steady-state
             lp_solves += 1
             best_s = None
             for c_, sol in zip(caps, sols):
@@ -907,6 +1263,7 @@ def realize_profile(
                 )
             lp_solves += 1
             log.count("decomp_host_syncs")  # deep device polish round trip
+            log.count("decomp_polish_syncs")  # end-game, not steady-state
             p_s = np.maximum(sol.x[: MTs.shape[1]], 0.0)
             tot = p_s.sum()
             if np.isfinite(tot) and tot > 0:
@@ -942,14 +1299,36 @@ def realize_profile(
     # anchor MILPs double-buffered against the device master (see
     # _AnchorPricer: identical column schedule whether threaded or inline),
     # a cold-restart policy for the warm-started master, and the batched
-    # device move screen on accelerator backends
+    # device move screen on accelerator backends. Behind the
+    # Config.decomp_device_pricing gate the anchor worker is the ACCELERATOR
+    # (solvers/device_pricing): one dispatch prices the whole batch, the
+    # host MILP runs only for tasks the device screen misses, and the move
+    # screen chains onto the master's device duals (_FusedScreen) so the
+    # steady-state round keeps a single host↔device synchronization point.
+    dev_pricer = None
+    if accel:
+        from citizensassemblies_tpu.solvers.device_pricing import (
+            DevicePricer,
+            device_pricing_enabled,
+        )
+
+        if device_pricing_enabled(cfg):
+            dev_pricer = DevicePricer(reduction, cfg=cfg, log=log)
     pricer = _AnchorPricer(
         oracle, rng, reduction,
         overlap=bool(getattr(cfg, "decomp_oracle_overlap", True)), log=log,
+        device=dev_pricer,
     )
     warm_enabled = bool(getattr(cfg, "decomp_warm_start", True))
     warm_stall = _WarmStall(int(getattr(cfg, "decomp_warm_stall_rounds", 3)))
     batched_expand = bool(getattr(cfg, "decomp_batched_expand", True)) and accel
+    fused_screen = (
+        _FusedScreen(reduction, per_round_cap=16_384, cfg=cfg)
+        if dev_pricer is not None and batched_expand
+        else None
+    )
+    if fused_screen is not None and not fused_screen.ok:  # pragma: no cover
+        fused_screen = None
     # batched polish-face screening (solvers/batch_lp.py): candidate support
     # prefixes solved as one vmapped dispatch in the end-game
     from citizensassemblies_tpu.solvers.batch_lp import (
@@ -1009,6 +1388,9 @@ def realize_profile(
                     f"  face rounds stalling at eps={eps_hist[-1]:.2e}; stopping early."
                 )
                 break
+            # per-round normalization for the host-sync gauge: bench rows and
+            # the smoke assertion report decomp_host_syncs / decomp_rounds
+            log.count("decomp_rounds")
             C = np.stack(cols, axis=0)
             MT = np.ascontiguousarray((C.astype(np.float64) / m[None, :]).T)
             # per-round master selection: small problems solve exactly on host
@@ -1068,15 +1450,31 @@ def realize_profile(
                             "sparse_fill_pct", int(round(100 * ell_now.fill))
                         )
                         log.count("sparse_hit" if use_sparse else "sparse_miss")
+                    screen_cb = None
+                    if fused_screen is not None:
+                        # the screened block is this master's own columns in
+                        # mass-ranked order (C is cols stacked: previous
+                        # prune's support first) — known NOW, before the
+                        # master returns, so the screen can chain onto its
+                        # device duals with no intermediate readback
+                        comps_block = C[:_SCREEN_ROWS]
+
+                        def screen_cb(lam_dev, _blk=comps_block):
+                            with log.timer("decomp_expand"):
+                                fused_screen.dispatch(_blk, lam_dev)
+
                     with log.timer("decomp_master"):
                         eps, w, p, eps_obj, pdhg_warm, _ok = _master_pdhg(
                             MT, v, cfg, warm_arg,
                             max_iters=4_096 if far else 12_288, tol=master_tol,
                             ell=ell_now if use_sparse else None,
+                            screen=screen_cb,
                         )
                     lp_solves += 1
                     # device master: operand upload + iterate harvest is one
-                    # host↔device round trip of the CG round
+                    # host↔device round trip of the CG round (in device-
+                    # pricing mode the fused screen and the lagged anchor
+                    # batch piggyback on this same synchronization point)
                     log.count("decomp_host_syncs")
                     polish_warm = pdhg_warm
                     if not warm_enabled:
@@ -1203,7 +1601,16 @@ def realize_profile(
                 cand.extend(pricer.harvest())
                 realized = MT @ p if len(p) == MT.shape[1] else None
                 pricer.submit(rnd, r_norm, eps, realized, v)
-            if kept:
+            if fused_screen is not None and fused_screen.pending:
+                with log.timer("decomp_expand"):
+                    # fused screen: dispatched during this round's master
+                    # against its own device duals, complete by the time the
+                    # master's readback returned — decoding it here costs no
+                    # additional host↔device synchronization
+                    moved = fused_screen.harvest()
+                    if len(moved):
+                        cand.append(moved)
+            elif kept:
                 with log.timer("decomp_expand"):
                     cand.append(
                         neighbor_columns(
@@ -1252,6 +1659,10 @@ def realize_profile(
                 # exhaustion with columns in flight
                 with log.timer("decomp_oracle"):
                     late = pricer.harvest()
+                if dev_pricer is not None:
+                    # the just-dispatched device batch had no master solve to
+                    # hide behind: this harvest blocks on in-flight compute
+                    log.count("decomp_host_syncs")
                 added = rank_add(late, r_norm)
             obj_note = f" obj~{eps_obj:.2e}" if use_pdhg else ""
             log.emit(

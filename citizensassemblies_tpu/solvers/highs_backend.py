@@ -69,7 +69,13 @@ class HighsCommitteeOracle:
         self,
         dense: DenseInstance,
         households: Optional[np.ndarray] = None,
+        log=None,
     ):
+        #: optional RunLog for oracle-mix attribution: every pricing call
+        #: counts the backend that actually served it
+        #: (``oracle_backend_native`` / ``oracle_backend_highs``), so bench
+        #: rows show the native-vs-MILP split instead of inferring it
+        self.log = log
         self.A = dense.A_np.astype(np.float64)
         self.n, self.F = self.A.shape
         self.k = dense.k
@@ -123,6 +129,8 @@ class HighsCommitteeOracle:
         if self.households is None:
             res = self._native_maximize(weights, incumbent=float(floor))
             if res is not None:
+                if self.log is not None:
+                    self.log.count("oracle_backend_native")
                 committee, value = res
                 return (None, float(floor)) if committee is None else (committee, value)
         # native unavailable or aborted on its node budget: go straight to the
@@ -148,12 +156,16 @@ class HighsCommitteeOracle:
         if self.households is None and not forced:
             res = self._native_maximize(weights)
             if res is not None:
+                if self.log is not None:
+                    self.log.count("oracle_backend_native")
                 return res
         return self._milp_maximize(weights, forced)
 
     def _milp_maximize(
         self, weights: np.ndarray, forced: Sequence[int] = ()
     ) -> Tuple[Tuple[int, ...], float]:
+        if self.log is not None:
+            self.log.count("oracle_backend_highs")
         committee, value, _bound = self._milp_maximize_with_bound(weights, forced)
         return committee, value
 

@@ -599,7 +599,41 @@ _pdhg_two_sided_core_ell = partial(
 )(_pdhg_two_sided_body_ell)
 
 
-def solve_two_sided_master(
+@dataclasses.dataclass
+class MasterHandle:
+    """An in-flight two-sided master solve: the core's raw DEVICE outputs
+    plus the decode metadata. ``finish_two_sided_master`` converts it to an
+    :class:`LPSolution` (the blocking readback); until then the arrays can
+    feed further device dispatches — the device-pricing round chains the
+    fused move screen onto ``lam`` so the whole round synchronizes once."""
+
+    x: object  # [Cp+1] f32 device array
+    lam: object  # [2T] f32 device array
+    mu: object  # [1] f32 device array
+    it: object  # i32 device scalar
+    res: object  # f32 device scalar
+    Cp: int
+    tol: float
+
+
+def finish_two_sided_master(h: MasterHandle) -> LPSolution:
+    """Blocking readback half of the async master solve."""
+    x = np.asarray(h.x, dtype=np.float64)
+    lam = np.asarray(h.lam, dtype=np.float64)
+    mu = np.asarray(h.mu, dtype=np.float64)
+    res_f = float(h.res)
+    return LPSolution(
+        ok=bool(res_f <= h.tol * 4.0),
+        x=x,
+        lam=lam,
+        mu=mu,
+        objective=float(x[h.Cp]),
+        iters=int(h.it),
+        kkt=res_f,
+    )
+
+
+def solve_two_sided_master_async(
     MT: np.ndarray,
     v: np.ndarray,
     cfg: Optional[Config] = None,
@@ -607,15 +641,10 @@ def solve_two_sided_master(
     tol: Optional[float] = None,
     max_iters: Optional[int] = None,
     bucket: int = 2048,
-) -> LPSolution:
-    """Device solve of the two-sided ε master via the structured core.
-
-    Drop-in for the ``solve_lp`` call that ``face_decompose._master_pdhg``
-    used to make on the stacked matrix, with identical (x, lam, mu) layout:
-    ``x = [p (Cp), ε]``, ``lam = [λ_lo (T), λ_up (T)]`` (so the pricing
-    duals are ``lam[:T] − lam[T:]``), ``mu = [μ]``. Columns are padded to
-    ``bucket`` so the jitted core compiles once per bucket.
-    """
+) -> MasterHandle:
+    """Dispatch half of :func:`solve_two_sided_master`: identical operand
+    prep and core call, but the outputs stay DEVICE arrays (no readback) so
+    a caller can enqueue dependent device work before blocking."""
     cfg = cfg or default_config()
     tol = float(tol if tol is not None else cfg.pdhg_tol)
     T, C = MT.shape
@@ -656,23 +685,11 @@ def solve_two_sided_master(
             max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
             check_every=int(cfg.pdhg_check_every),
         )
-    x = np.asarray(x, dtype=np.float64)
-    lam = np.asarray(lam, dtype=np.float64)
-    mu = np.asarray(mu, dtype=np.float64)
-    res_f = float(res)
-    return LPSolution(
-        ok=bool(res_f <= tol * 4.0),
-        x=x,
-        lam=lam,
-        mu=mu,
-        objective=float(x[Cp]),
-        iters=int(it),
-        kkt=res_f,
-    )
+    return MasterHandle(x=x, lam=lam, mu=mu, it=it, res=res, Cp=Cp, tol=tol)
 
 
-def solve_two_sided_master_ell(
-    ell,
+def solve_two_sided_master(
+    MT: np.ndarray,
     v: np.ndarray,
     cfg: Optional[Config] = None,
     warm: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
@@ -680,7 +697,33 @@ def solve_two_sided_master_ell(
     max_iters: Optional[int] = None,
     bucket: int = 2048,
 ) -> LPSolution:
-    """Device solve of the two-sided ε master on the ELL rep.
+    """Device solve of the two-sided ε master via the structured core.
+
+    Drop-in for the ``solve_lp`` call that ``face_decompose._master_pdhg``
+    used to make on the stacked matrix, with identical (x, lam, mu) layout:
+    ``x = [p (Cp), ε]``, ``lam = [λ_lo (T), λ_up (T)]`` (so the pricing
+    duals are ``lam[:T] − lam[T:]``), ``mu = [μ]``. Columns are padded to
+    ``bucket`` so the jitted core compiles once per bucket.
+    """
+    return finish_two_sided_master(
+        solve_two_sided_master_async(
+            MT, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters,
+            bucket=bucket,
+        )
+    )
+
+
+def solve_two_sided_master_ell_async(
+    ell,
+    v: np.ndarray,
+    cfg: Optional[Config] = None,
+    warm: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    tol: Optional[float] = None,
+    max_iters: Optional[int] = None,
+    bucket: int = 2048,
+) -> MasterHandle:
+    """Dispatch half of :func:`solve_two_sided_master_ell` (see
+    :func:`solve_two_sided_master_async`): device outputs, no readback.
 
     ``ell`` is a :class:`~citizensassemblies_tpu.solvers.sparse_ops.EllPack`
     of the master's COLUMNS (minor axis = the T types). Drop-in for
@@ -731,18 +774,26 @@ def solve_two_sided_master_ell(
             max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
             check_every=int(cfg.pdhg_check_every),
         )
-    x = np.asarray(x, dtype=np.float64)
-    lam = np.asarray(lam, dtype=np.float64)
-    mu = np.asarray(mu, dtype=np.float64)
-    res_f = float(res)
-    return LPSolution(
-        ok=bool(res_f <= tol * 4.0),
-        x=x,
-        lam=lam,
-        mu=mu,
-        objective=float(x[Cp]),
-        iters=int(it),
-        kkt=res_f,
+    return MasterHandle(x=x, lam=lam, mu=mu, it=it, res=res, Cp=Cp, tol=tol)
+
+
+def solve_two_sided_master_ell(
+    ell,
+    v: np.ndarray,
+    cfg: Optional[Config] = None,
+    warm: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    tol: Optional[float] = None,
+    max_iters: Optional[int] = None,
+    bucket: int = 2048,
+) -> LPSolution:
+    """Blocking wrapper of :func:`solve_two_sided_master_ell_async` — the
+    drop-in ELL twin of :func:`solve_two_sided_master` (same (x, lam, mu)
+    layout and warm-start contract)."""
+    return finish_two_sided_master(
+        solve_two_sided_master_ell_async(
+            ell, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters,
+            bucket=bucket,
+        )
     )
 
 

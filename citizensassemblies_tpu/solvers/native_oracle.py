@@ -17,6 +17,7 @@ those calls always use the HiGHS path.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
@@ -33,6 +34,29 @@ _SO = os.path.join(_REPO_ROOT, "native", "build", "libbb_price.so")
 _lock = threading.Lock()
 _lib = None
 _lib_failed = False
+
+_logger = logging.getLogger("citizensassemblies_tpu.native")
+#: libraries whose toolchain failure has already been reported — the load
+#: attempt itself happens once per process (the ``*_failed`` flags), but the
+#: REASON used to be swallowed entirely; now it is logged exactly once per
+#: library so a missing g++ or a broken source shows up in the run log
+#: instead of silently degrading every oracle call to the HiGHS fallback
+_toolchain_logged: set = set()
+
+
+def _note_toolchain_failure(name: str, exc: Exception) -> None:
+    """Log a native-toolchain compile/load failure ONCE per process."""
+    if name in _toolchain_logged:
+        return
+    _toolchain_logged.add(name)
+    detail = str(exc)
+    if isinstance(exc, subprocess.CalledProcessError) and exc.stderr:
+        detail = exc.stderr.decode("utf-8", "replace")
+    _logger.warning(
+        "native %s unavailable (%s: %.200s); scipy/HiGHS fallback will carry "
+        "its calls for the rest of the process",
+        name, type(exc).__name__, detail,
+    )
 
 
 def _compile_and_load(src: str, so: str) -> ctypes.CDLL:
@@ -75,7 +99,8 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int64),  # out_nodes
             ]
             _lib = lib
-        except Exception:
+        except Exception as exc:
+            _note_toolchain_failure("bb_price", exc)
             _lib_failed = True
             _lib = None
         return _lib
@@ -219,7 +244,8 @@ def _load_repair() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int32),  # out [R*T]
             ]
             _repair_lib = lib
-        except Exception:
+        except Exception as exc:
+            _note_toolchain_failure("slice_repair", exc)
             _repair_failed = True
             _repair_lib = None
         return _repair_lib
@@ -367,7 +393,8 @@ def _load_slicer() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int),     # out_count
             ]
             _slicer_lib = lib
-        except Exception:
+        except Exception as exc:
+            _note_toolchain_failure("slicer", exc)
             _slicer_failed = True
             _slicer_lib = None
         return _slicer_lib

@@ -26,15 +26,22 @@ from citizensassemblies_tpu.models.legacy import sample_panels_batch
 from citizensassemblies_tpu.utils.config import Config, default_config
 
 
-def _pricing_scores(weights: jnp.ndarray, batch: int) -> jnp.ndarray:
-    """[B, n] member-pick scores: β_b · ŵ with a log-spaced β ladder.
+def beta_ladder(batch: int, lo: float = -1.0, hi: float = 3.5) -> np.ndarray:
+    """Log-spaced inverse-temperature ladder β ∈ [10^lo, 10^hi].
 
-    Low β chains explore (near-uniform LEGACY draws keep the portfolio
-    diverse); high β chains exploit (near-greedy on the dual weights y, which
-    is what finds violated constraints when y concentrates on few agents).
+    The steering schedule shared by the stochastic committee pricer below
+    and the device anchor pricer (``solvers/device_pricing.py``): low β
+    explores (feasibility/diversity dominated), high β exploits (greedy on
+    the dual weights, which is what finds violated columns when the duals
+    concentrate on few agents/types).
     """
+    return np.logspace(lo, hi, batch)
+
+
+def _pricing_scores(weights: jnp.ndarray, batch: int) -> jnp.ndarray:
+    """[B, n] member-pick scores: β_b · ŵ with the log-spaced β ladder."""
     w = weights / (jnp.max(jnp.abs(weights)) + 1e-12)
-    betas = jnp.logspace(-1.0, 3.5, batch)
+    betas = jnp.asarray(beta_ladder(batch), dtype=w.dtype)
     return betas[:, None] * w[None, :]
 
 

@@ -153,6 +153,18 @@ class Config:
     #: it the batched path keeps the first (mass-ordered) feasible moves
     #: where the numpy path subsamples randomly.
     decomp_batched_expand: bool = True
+    #: device-resident anchor pricing for the face-decomposition loop
+    #: (``solvers/device_pricing.py``): the per-round anchor batch is priced
+    #: in ONE jitted device dispatch (β-ladder greedy lanes; an exact DP
+    #: lane on single-category reductions) overlapped with the next master,
+    #: the exact host MILP runs only for tasks the device screen misses
+    #: (``decomp_oracle_device_hit``/``_miss``), and the batched move screen
+    #: goes one-round-lagged so the steady-state CG round keeps a single
+    #: host↔device synchronization point (``decomp_host_syncs`` ≤ 1 per
+    #: round). Tri-state: ``None`` = auto (on on accelerator backends, off
+    #: on CPU), ``True``/``False`` force. Off ⇒ the host anchor-MILP
+    #: schedule runs bit-identically (the pre-device-pricing engine).
+    decomp_device_pricing: Optional[bool] = None
     # NOTE: an earlier `decomp_multicut` knob (exact MILPs per decomposition
     # round) was absorbed into the face loop's fixed anchor schedule (one
     # dual-direction anchor + alternate-round noisy pair + up to three

@@ -1,0 +1,303 @@
+"""Device-resident anchor pricing (solvers/device_pricing) + the fused round.
+
+The contracts pinned here:
+
+* **Feasibility is a hard contract** — every anchor the device pricer emits
+  (β-ladder greedy lanes and the exact DP lane) is a quota-feasible
+  composition, re-proven by independent integer arithmetic in the test, not
+  just by the pricer's own validator.
+* **The exact DP lane is exact** — on single-category reductions its anchor
+  value matches the HiGHS MILP optimum.
+* **The fallback ladder routes correctly** — a device hit skips the host
+  MILP entirely; a device miss still calls it (the screen only ever REDUCES
+  host oracle work); forced-inclusion tasks carry their type through the
+  device lane and through the HiGHS fallback alike.
+* **The gate is bit-exact when off** — ``decomp_device_pricing=False`` and
+  the CPU auto-default produce the identical portfolio (the PR 6 engine),
+  so every pre-existing behavior contract survives the gate untouched.
+* **The device round is sync-lean** — with the gate on, the face loop still
+  certifies while its steady-state rounds make at most one host↔device
+  synchronization each (the ``decomp_host_syncs``/``decomp_rounds`` gauge
+  pair the bench rows and ``--smoke`` report).
+"""
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import skewed_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.solvers.cg_typespace import (
+    CompositionOracle,
+    _leximin_relaxation,
+    _slice_relaxation,
+)
+from citizensassemblies_tpu.solvers.device_pricing import DevicePricer
+from citizensassemblies_tpu.solvers.face_decompose import (
+    _AnchorPricer,
+    _FusedScreen,
+    realize_profile,
+)
+from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+from citizensassemblies_tpu.utils.config import default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+
+def _reduction(n=160, k=14, n_categories=3, seed=5):
+    dense, _ = featurize(
+        skewed_instance(n=n, k=k, n_categories=n_categories, seed=seed)
+    )
+    return TypeReduction(dense)
+
+
+def _assert_feasible(red: TypeReduction, comp: np.ndarray):
+    """Independent integer feasibility check of one composition."""
+    comp = np.asarray(comp, dtype=np.int64).ravel()
+    assert comp.sum() == red.k
+    assert (comp >= 0).all() and (comp <= red.msize).all()
+    counts = np.zeros(red.F, dtype=np.int64)
+    for t in range(red.T):
+        counts[red.type_feature[t]] += comp[t]
+    assert (counts >= red.qmin).all(), (counts, red.qmin)
+    assert (counts <= red.qmax).all(), (counts, red.qmax)
+
+
+class _CountingOracle:
+    """CompositionOracle proxy that counts maximize calls."""
+
+    def __init__(self, red):
+        self.inner = CompositionOracle(red)
+        self.calls = 0
+
+    def maximize(self, *a, **kw):
+        self.calls += 1
+        return self.inner.maximize(*a, **kw)
+
+
+class _AlwaysMissPricer:
+    """Stub device pricer whose every task misses (forces the host ladder)."""
+
+    def dispatch(self, tasks):
+        return ("stub", list(tasks))
+
+    def harvest(self, handle):
+        return [], list(range(len(handle[1])))
+
+
+def test_greedy_lanes_feasible_and_useful():
+    """Every anchor the β-ladder lanes emit is quota-feasible (independent
+    arithmetic), and the best lane recovers a meaningful fraction of the
+    exact anchor value — it is a column generator, not noise."""
+    red = _reduction()
+    pricer = DevicePricer(red)
+    oracle = CompositionOracle(red)
+    rng = np.random.default_rng(0)
+    tasks = [(rng.normal(0, 1.0, red.T), None) for _ in range(4)]
+    hits, missed = pricer.harvest(pricer.dispatch(tasks))
+    assert len(hits) + len(missed) == len(tasks)
+    assert len(hits) >= 3  # the easy fixture should rarely miss
+    for i, comp in hits:
+        _assert_feasible(red, comp)
+        w = np.asarray(tasks[i][0])
+        exact = oracle.maximize(w)
+        assert exact is not None
+        dev_val = float(comp.astype(np.float64).ravel() @ w)
+        assert dev_val >= 0.5 * exact[1] - 1e-9, (dev_val, exact[1])
+
+
+def test_exact_dp_lane_matches_milp():
+    """Single-category reductions route the exact DP lane: anchor values
+    equal the HiGHS MILP optimum (exact over the uploaded weights)."""
+    red = _reduction(n=120, k=10, n_categories=1, seed=3)
+    assert red.n_cats == 1
+    pricer = DevicePricer(red)
+    assert pricer.exact
+    oracle = CompositionOracle(red)
+    rng = np.random.default_rng(1)
+    tasks = [(rng.normal(0, 1.0, red.T), None) for _ in range(4)]
+    hits, missed = pricer.harvest(pricer.dispatch(tasks))
+    assert not missed
+    for i, comp in hits:
+        _assert_feasible(red, comp)
+        w = np.asarray(tasks[i][0])
+        exact = oracle.maximize(w)
+        dev_val = float(comp.astype(np.float64).ravel() @ w)
+        assert abs(dev_val - exact[1]) <= 1e-6 * (1.0 + abs(exact[1]))
+
+
+def test_forced_inclusion_routes_through_device_lane():
+    """A forced-inclusion task's surviving lanes all contain the forced
+    type; the emitted anchor is feasible with it."""
+    red = _reduction()
+    pricer = DevicePricer(red)
+    rng = np.random.default_rng(2)
+    # force a type the dual direction would never pick: most negative weight
+    w = rng.normal(0, 1.0, red.T)
+    forced = int(np.argmin(w))
+    hits, missed = pricer.harvest(pricer.dispatch([(w, forced)]))
+    assert [i for i, _ in hits] == [0] or missed == [0]
+    if hits:
+        comp = hits[0][1].ravel()
+        assert comp[forced] >= 1
+        _assert_feasible(red, comp)
+
+
+def test_device_hit_skips_host_milp():
+    """The fallback ladder, hit side: tasks the device serves never reach
+    the host oracle."""
+    red = _reduction()
+    oracle = _CountingOracle(red)
+    log = RunLog(echo=False)
+    pricer = _AnchorPricer(
+        oracle, np.random.default_rng(0), red, overlap=True, log=log,
+        device=DevicePricer(red, log=log),
+    )
+    pricer.submit(1, np.random.default_rng(3).normal(0, 1e-3, red.T), 1e-3, None, None)
+    cols = pricer.harvest()
+    pricer.close()
+    hits = log.counters.get("decomp_oracle_device_hit", 0)
+    assert hits >= 1
+    assert oracle.calls == log.counters.get("decomp_oracle_device_miss", 0)
+    for comp in cols[:hits]:
+        _assert_feasible(red, comp)
+
+
+def test_device_miss_falls_back_to_host_milp():
+    """The fallback ladder, miss side: a task with no surviving device lane
+    still gets its exact host MILP — and certifies a usable column."""
+    red = _reduction()
+    oracle = _CountingOracle(red)
+    log = RunLog(echo=False)
+    pricer = _AnchorPricer(
+        oracle, np.random.default_rng(0), red, overlap=True, log=log,
+        device=_AlwaysMissPricer(),
+    )
+    r_norm = np.random.default_rng(4).normal(0, 1e-3, red.T)
+    pricer.submit(1, r_norm, 1e-3, None, None)
+    cols = pricer.harvest()
+    pricer.close()
+    assert oracle.calls == 1  # one task (odd round: no noisy variants)
+    assert log.counters.get("decomp_oracle_device_miss", 0) == 1
+    assert "decomp_oracle_device_hit" not in log.counters
+    assert len(cols) == 1
+    _assert_feasible(red, cols[0])
+
+
+def test_fused_screen_emits_feasible_moves():
+    """Every move the fused (pair-selection-on-device) screen emits is a
+    quota-feasible composition — checked by independent arithmetic against
+    the screen's base block."""
+    import jax.numpy as jnp
+
+    red = _reduction()
+    oracle = CompositionOracle(red)
+    rng = np.random.default_rng(6)
+    comps = []
+    for _ in range(8):
+        got = oracle.maximize(rng.normal(0, 1.0, red.T))
+        if got is not None:
+            comps.append(got[0])
+    comps = np.stack(comps).astype(np.int16)
+    screen = _FusedScreen(red, per_round_cap=16_384, cfg=default_config())
+    assert screen.ok
+    # a synthetic dual vector: lam = [lam_lo, lam_up], w = lam_lo − lam_up
+    lam = jnp.asarray(
+        np.abs(rng.normal(0, 1e-3, 2 * red.T)).astype(np.float32)
+    )
+    assert screen.dispatch(comps, lam)
+    moved = screen.harvest()
+    assert moved.shape[0] > 0
+    assert not screen.pending
+    for comp in moved[:64]:
+        _assert_feasible(red, comp)
+    # a second harvest without a dispatch is empty, not stale
+    assert screen.harvest().shape[0] == 0
+
+
+def test_household_quotient_routes_device_lane():
+    """Household anchors price through the device lane too: the quotient's
+    augmented reduction (class-cap features push F > 64, one extra
+    category) is just another TypeReduction to the greedy core, and its
+    anchors come back feasible against the augmented quota system."""
+    from citizensassemblies_tpu.solvers.quotient import build_household_quotient
+
+    inst = skewed_instance(
+        n=240, k=16, n_categories=3, seed=7, features_per_category=[3, 3, 3]
+    )
+    dense, _ = featurize(inst)
+    hh = (np.arange(240) // 2).astype(np.int32)
+    red = TypeReduction(build_household_quotient(dense, hh).dense_aug)
+    assert red.F > 64
+    pricer = DevicePricer(red)
+    rng = np.random.default_rng(9)
+    w = rng.normal(0, 1.0, red.T)
+    forced = int(np.argmax(red.msize))  # a well-populated orbit
+    hits, missed = pricer.harvest(pricer.dispatch([(w, None), (w, forced)]))
+    assert len(hits) >= 1
+    for i, comp in hits:
+        _assert_feasible(red, comp)
+        if i == 1:
+            assert comp.ravel()[forced] >= 1
+
+
+def _profile_fixture(seed=1):
+    dense, _ = featurize(skewed_instance(n=120, k=12, n_categories=3, seed=seed))
+    red = TypeReduction(dense)
+    v_relax, _x = _leximin_relaxation(red, RunLog(echo=False))
+    seeds = _slice_relaxation(v_relax * red.msize.astype(np.float64), red, R=8)
+    return red, v_relax, seeds
+
+
+def test_gate_off_is_bit_identical_to_auto_cpu():
+    """``decomp_device_pricing=False`` and the CPU auto-default run the
+    identical engine: same portfolio, bitwise — the PR 6 regression
+    contract for every gate-off path."""
+    red, v_relax, seeds = _profile_fixture()
+    results = {}
+    for name, cfg in (
+        ("auto", default_config().replace(decomp_host_master_max_types=0)),
+        ("off", default_config().replace(
+            decomp_host_master_max_types=0, decomp_device_pricing=False
+        )),
+    ):
+        log = RunLog(echo=False)
+        C, p, eps, _solves = realize_profile(
+            red, v_relax, list(seeds), CompositionOracle(red), 5e-4,
+            log=log, max_rounds=6, use_pdhg=True, cfg=cfg,
+        )
+        results[name] = (C, p, eps, log.counters)
+    C_a, p_a, eps_a, cnt_a = results["auto"]
+    C_o, p_o, eps_o, cnt_o = results["off"]
+    assert np.array_equal(C_a, C_o)
+    assert np.array_equal(p_a, p_o)
+    assert eps_a == eps_o
+    # neither run engaged any device-pricing machinery on the CPU backend
+    for cnt in (cnt_a, cnt_o):
+        assert "decomp_oracle_device_hit" not in cnt
+        assert "decomp_oracle_device_miss" not in cnt
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_device_mode_certifies_with_single_sync_rounds(seed):
+    """Gate on: the face loop still certifies the profile, the device
+    pricer serves anchors, and the steady-state rounds make at most ONE
+    host↔device synchronization each (the ISSUE 7 acceptance bar, measured
+    by the decomp_host_syncs − decomp_polish_syncs vs decomp_rounds gauge
+    pair the bench smoke also asserts)."""
+    red, v_relax, seeds = _profile_fixture(seed=seed)
+    cfg = default_config().replace(
+        decomp_host_master_max_types=0, decomp_device_pricing=True
+    )
+    log = RunLog(echo=False)
+    C, p, eps, _solves = realize_profile(
+        red, v_relax, list(seeds), CompositionOracle(red), 1e-3,
+        log=log, max_rounds=8, use_pdhg=True, cfg=cfg,
+    )
+    bar = max(cfg.decomp_accept, cfg.decomp_accept_stalled, 1e-3)
+    assert eps <= bar
+    mix = p @ (C.astype(np.float64) / red.msize[None, :])
+    assert float(np.abs(mix - v_relax).max()) <= eps + 1e-12
+    c = log.counters
+    rounds = c.get("decomp_rounds", 0)
+    steady = c.get("decomp_host_syncs", 0) - c.get("decomp_polish_syncs", 0)
+    assert rounds >= 1
+    assert steady <= rounds, c
