@@ -26,3 +26,9 @@ check-ir:
 # deliberate ratchet move: re-measure every core and rewrite the budget
 update-ir-budget:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m citizensassemblies_tpu.lint --ir --update-budget
+
+# grafttrace bench trend gate (obs/trend.py): per-row regression check over
+# the committed BENCH_*.json / BENCH_serve_*.json trajectory. Stdlib-only —
+# no accelerator stack needed, same posture as `lint`.
+trend:
+	python bench.py --trend

@@ -17,7 +17,14 @@ smoke run.
 1 rep, the slow rows skipped — but the INVARIANT assertions (batched-engine
 parity vs the serial solver, solves-per-dispatch, warm-call compile bound)
 run for real and fail the process, so a dispatch-count or compile-bound
-regression fails CI rather than waiting for the offline bench.
+regression fails CI rather than waiting for the offline bench. The smoke
+also runs a TRACED face decomposition (grafttrace sampling mode), asserts
+its Chrome-trace artifact validates and covers ≥ 90 % of the phase, and
+writes ``trace_smoke.json`` + ``metrics_smoke.prom`` for the CI upload.
+
+``python bench.py --trend`` is the regression gate over the committed
+BENCH_*.json / BENCH_serve_*.json trajectory (``obs/trend.py``): per-row
+deltas vs the best earlier round, non-zero exit past the tolerance.
 """
 
 from __future__ import annotations
@@ -193,6 +200,35 @@ def main() -> None:
     warm = random_instance(n=64, k=8, n_categories=2, seed=0)
     wdense, wspace = featurize(warm)
     find_distribution_leximin(wdense, wspace)
+
+    # obs stamp for the evidence row: a second warm-instance rep untraced vs
+    # traced (sampling mode) gives the per-run trace overhead; span count and
+    # schema version ride along so every bench row records which grafttrace
+    # contract it was measured under. Tracing the FLAGSHIP runs stays off —
+    # the headline numbers must measure the solver, not the tracer.
+    from citizensassemblies_tpu.obs import TRACE_SCHEMA_VERSION, Tracer, use_tracer
+    from citizensassemblies_tpu.utils.config import default_config as _dc
+    from citizensassemblies_tpu.utils.logging import RunLog as _ObsRunLog
+
+    t_plain = time.time()
+    find_distribution_leximin(wdense, wspace)
+    t_plain = time.time() - t_plain
+    _obs_tr = Tracer(name="bench_warm", sample_device=True)
+    _obs_log = _ObsRunLog(echo=False)
+    _obs_log.tracer = _obs_tr
+    t_traced = time.time()
+    with use_tracer(_obs_tr):
+        find_distribution_leximin(
+            wdense, wspace, cfg=_dc().replace(obs_trace=True), log=_obs_log
+        )
+    t_traced = time.time() - t_traced
+    obs_stamp = {
+        "schema_version": TRACE_SCHEMA_VERSION,
+        "span_count": _obs_tr.span_count,
+        "trace_overhead_pct": round(
+            100 * (t_traced - t_plain) / max(t_plain, 1e-9), 1
+        ),
+    }
 
     t0 = time.time()
     dist = find_distribution_leximin(dense, space)
@@ -654,6 +690,9 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": round(elapsed / baseline, 4),
         "detail": detail,
+        # grafttrace provenance of the row (schema, span count and measured
+        # overhead of the warm-instance traced rep — see obs_stamp above)
+        "obs": obs_stamp,
     }
     # budget provenance: which ANALYSIS_BUDGET.json ratchet state this
     # evidence row was measured against (sha + core count + jax version)
@@ -892,15 +931,18 @@ def smoke() -> int:
     )
     dp_counters = {}
     dp_eps = {}
+    dp_times = {}
     for gate in (False, True):
         dp_cfg = cfg.replace(
             decomp_host_master_max_types=0, decomp_device_pricing=gate
         )
         dp_log = RunLog(echo=False)
+        t_dp = time.time()
         _C, _p, eps_run, _s = realize_profile(
             dp_red, dp_v, list(dp_seeds), CompositionOracle(dp_red, log=dp_log),
             5e-4, log=dp_log, max_rounds=8, use_pdhg=True, cfg=dp_cfg,
         )
+        dp_times[gate] = time.time() - t_dp
         dp_counters[gate] = dp_log.counters
         dp_eps[gate] = eps_run
     sync_host = dp_counters[False].get("decomp_host_syncs", 0)
@@ -924,6 +966,73 @@ def smoke() -> int:
         failures.append(
             f"device-pricing run failed to certify (eps {dp_eps[True]:.2e})"
         )
+
+    # --- grafttrace: traced face decomposition + artifact + coverage --------
+    # the SAME tiny decomposition once more under a sampling tracer
+    # (Config.obs_trace=True): asserts the acceptance-criteria contract —
+    # the exported Chrome trace validates against the schema and its spans
+    # cover ≥ 90 % of the face-decomposition phase's wall time — and writes
+    # the trace + a Prometheus metrics snapshot as CI artifacts. The
+    # untraced gate=True run above doubles as the overhead baseline for the
+    # row's obs stamp (recorded, not asserted: tiny runs are noisy).
+    from citizensassemblies_tpu.obs import (
+        Tracer,
+        export_chrome_trace,
+        span_coverage,
+        use_tracer,
+        validate_chrome_trace,
+    )
+
+    obs_cfg = cfg.replace(
+        decomp_host_master_max_types=0, decomp_device_pricing=True,
+        obs_trace=True,
+    )
+    obs_tracer = Tracer(name="smoke_face_decompose", sample_device=True)
+    obs_log = RunLog(echo=False)
+    obs_log.tracer = obs_tracer
+    t_traced = time.time()
+    with use_tracer(obs_tracer):
+        with obs_tracer.span("face_decompose"):
+            realize_profile(
+                dp_red, dp_v, list(dp_seeds),
+                CompositionOracle(dp_red, log=obs_log),
+                5e-4, log=obs_log, max_rounds=8, use_pdhg=True, cfg=obs_cfg,
+            )
+    t_traced = time.time() - t_traced
+    coverage = span_coverage(obs_tracer, "face_decompose")
+    if coverage < 0.90:
+        failures.append(
+            f"trace spans cover {coverage:.1%} of the face-decomposition "
+            "phase (< 90%)"
+        )
+    root = os.path.dirname(os.path.abspath(__file__))
+    trace_path = os.environ.get(
+        "BENCH_TRACE_PATH", os.path.join(root, "trace_smoke.json")
+    )
+    trace_doc = export_chrome_trace([obs_tracer], path=trace_path)
+    schema_problems = validate_chrome_trace(trace_doc)
+    if schema_problems:
+        failures.append(f"trace schema invalid: {schema_problems[:3]}")
+    metrics_path = os.environ.get(
+        "BENCH_METRICS_PATH", os.path.join(root, "metrics_smoke.prom")
+    )
+    try:
+        with open(metrics_path, "w", encoding="utf-8") as fh:
+            fh.write(obs_log.metrics.render_prometheus())
+    except OSError:
+        metrics_path = "(unwritable)"
+    obs_stamp = {
+        "schema_version": trace_doc["schema_version"],
+        "span_count": obs_tracer.span_count,
+        "decomp_span_coverage_pct": round(100 * coverage, 1),
+        # traced (block-until-ready sampling) vs untraced wall of the same
+        # tiny decomposition — noisy at this scale, recorded for the trend
+        "trace_overhead_pct": round(
+            100 * (t_traced - dp_times[True]) / max(dp_times[True], 1e-9), 1
+        ),
+        "trace_file": os.path.basename(str(trace_path)),
+        "metrics_file": os.path.basename(str(metrics_path)),
+    }
 
     # --- tiny end-to-end parity (engine on vs off) + warm compile bound ----
     dense, space = featurize(random_instance(n=64, k=8, n_categories=2, seed=0))
@@ -966,6 +1075,7 @@ def smoke() -> int:
                 "lp_batch_counters": dict(slog.counters),
                 "warm_fleet_compiles": warm_guard.count,
                 "warm_leximin_compiles": lex_guard.count,
+                "obs": obs_stamp,
                 "failures": failures,
             }
         )
@@ -1001,9 +1111,15 @@ def serve_bench(smoke_mode: bool = False) -> int:
     failures = []
     bound = int(os.environ.get("BENCH_COMPILE_BOUND", "8"))
     # the engine is exercised explicitly (CPU CI would auto-route it off);
-    # the window is held slightly open so concurrent fleets actually meet
+    # the window is held slightly open so concurrent fleets actually meet.
+    # obs_trace=True gives every request its own sampling tracer (the serve
+    # trace artifact merges them, one process lane per request), and the
+    # smoke's short metrics interval exercises the periodic ("metrics", …)
+    # channel snapshots the streaming satellite added.
     cfg = default_config().replace(
         lp_batch=True, serve_batch_window_ms=8.0, serve_admission_cap=8,
+        obs_trace=True,
+        obs_metrics_interval_s=(0.2 if smoke_mode else 0.0),
     )
 
     # --- the fleet: mixed-size tenant instances (mass_like_24-class) --------
@@ -1080,6 +1196,55 @@ def serve_bench(smoke_mode: bool = False) -> int:
     memo_hits = sum(1 for r in warm_res if r.from_memo)
     if warm_ok and memo_hits == 0:
         failures.append("identical re-submission was not served from the tenant memo")
+
+    # --- grafttrace artifacts: merged per-request trace + Prometheus dump --
+    from citizensassemblies_tpu.obs import validate_chrome_trace
+
+    root_dir = os.path.dirname(os.path.abspath(__file__))
+    serve_trace_path = os.environ.get(
+        "BENCH_SERVE_TRACE_PATH", os.path.join(root_dir, "trace_serve_smoke.json")
+    ) if smoke_mode else os.path.join(root_dir, "trace_serve.json")
+    serve_doc = svc.export_traces(path=serve_trace_path)
+    serve_schema_problems = validate_chrome_trace(serve_doc)
+    if serve_schema_problems:
+        failures.append(f"serve trace schema invalid: {serve_schema_problems[:3]}")
+    prom_text = svc.metrics_text()
+    serve_metrics_path = os.path.join(
+        root_dir, "metrics_serve_smoke.prom" if smoke_mode else "metrics_serve.prom"
+    )
+    try:
+        with open(serve_metrics_path, "w", encoding="utf-8") as fh:
+            fh.write(prom_text)
+    except OSError:
+        serve_metrics_path = "(unwritable)"
+    span_total = sum(
+        1 for ev in serve_doc["traceEvents"] if ev.get("ph") == "X"
+    )
+    obs_stamp = {
+        "schema_version": serve_doc["schema_version"],
+        "span_count": span_total,
+        "traced_requests": len(serve_doc["otherData"]["tracers"]),
+        "trace_file": os.path.basename(str(serve_trace_path)),
+        "metrics_file": os.path.basename(str(serve_metrics_path)),
+    }
+    if smoke_mode:
+        # the streaming-snapshot satellite: at least one channel must have
+        # received a periodic ("metrics", …) event during the fleet run
+        metrics_events = 0
+        for _t_sub, ch in chans:
+            metrics_events += sum(
+                1 for kind, _p in ch.events(timeout=1) if kind == "metrics"
+            )
+        obs_stamp["metrics_events"] = metrics_events
+        if metrics_events == 0:
+            failures.append(
+                "no channel received a periodic metrics snapshot "
+                "(obs_metrics_interval_s stream inert)"
+            )
+        if span_total == 0:
+            failures.append("serve trace recorded no spans (obs_trace inert)")
+        if "graftserve_requests_total" not in prom_text:
+            failures.append("prometheus dump missing graftserve_requests_total")
     svc.shutdown()
 
     lat.sort()
@@ -1113,6 +1278,7 @@ def serve_bench(smoke_mode: bool = False) -> int:
             "warm_memo_hits": memo_hits,
             "tenants": svc.tenants.all_stats(),
             "memo_evictions_by_owner": memo_evictions_by_owner(),
+            "obs": obs_stamp,
             "failures": failures,
         },
     }
@@ -1125,13 +1291,36 @@ def serve_bench(smoke_mode: bool = False) -> int:
             "fused_dispatches": bstats["fused_dispatches"],
             "worst_alloc_linf_dev": round(worst_dev, 9),
             "warm_compiles": warm_guard.count,
+            "obs": obs_stamp,
             "failures": failures,
         }
     print(json.dumps(row))
     return 1 if failures else 0
 
 
+def trend() -> int:
+    """``bench.py --trend``: the regression gate over the committed BENCH
+    trajectory (``obs/trend.py``). Prints one JSON line (per-row deltas,
+    statuses, failures) and exits non-zero on any row whose latest value
+    regressed beyond ``Config.obs_trend_tol`` × its best earlier round —
+    the CI job that turns the BENCH_*.json series into an enforced budget.
+
+    Stdlib-only on purpose (no jax import), so the CI gate job needs no
+    accelerator stack — same posture as graftlint.
+    """
+    from citizensassemblies_tpu.obs.trend import trend_gate
+
+    root = os.environ.get(
+        "BENCH_TREND_ROOT", os.path.dirname(os.path.abspath(__file__))
+    )
+    report = trend_gate(root)
+    print(json.dumps(report.as_json()))
+    return 0 if report.ok else 1
+
+
 if __name__ == "__main__":
+    if "--trend" in sys.argv:
+        raise SystemExit(trend())
     if "--serve" in sys.argv:
         raise SystemExit(serve_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:

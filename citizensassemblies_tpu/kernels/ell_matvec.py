@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 
 
 def _round_up(x: int, m: int) -> int:
@@ -100,14 +101,16 @@ def ell_gather_mv_pallas(
     val_p[:C] = val
     y_p = np.zeros((1, minor_pad), np.float32)
     y_p[0, : y.shape[0]] = y
-    out = _ell_gather_call(
-        jnp.asarray(idx_p), jnp.asarray(val_p), jnp.asarray(y_p),
-        block_c=block_c, interpret=bool(interpret),
-    )
+    with dispatch_span("kernels.pallas_ell_matvec", cols=int(C)) as _ds:
+        out = _ell_gather_call(
+            jnp.asarray(idx_p), jnp.asarray(val_p), jnp.asarray(y_p),
+            block_c=block_c, interpret=bool(interpret),
+        )
+        _ds.out = out
     return out[:C]
 
 
-@register_ir_core("kernels.pallas_ell_matvec")
+@register_ir_core("kernels.pallas_ell_matvec", span="kernels.pallas_ell_matvec")
 def _ir_pallas_ell_matvec() -> IRCase:
     """The kernel at one minimum-padded shape, in interpret mode so it
     lowers on CPU — the grid/VMEM structure (blocked packed operands, one

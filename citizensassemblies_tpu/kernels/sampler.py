@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 
 NEG_INF = -1e30
 
@@ -216,7 +217,7 @@ def _pallas_sample(
     return panels[:, :k], ok[:, 0].astype(bool)
 
 
-@register_ir_core("kernels.pallas_sampler")
+@register_ir_core("kernels.pallas_sampler", span="kernels.pallas_sampler")
 def _ir_pallas_sampler() -> IRCase:
     """The fused draw at one minimum-padded shape, in interpret mode so the
     kernel lowers on CPU. The murmur3 RNG is in-register by design — the IR
@@ -364,19 +365,21 @@ def sample_panels_pallas(
     seed = jnp.asarray(
         jax.random.randint(key, (1,), 0, np.iinfo(np.int32).max), dtype=jnp.int32
     )
-    panels, ok = _pallas_sample(
-        A_d,
-        AT_d,
-        qmin_d,
-        qmax_d,
-        sc,
-        jnp.asarray(hh),
-        seed,
-        B=B_pad,
-        block_b=block_b,
-        k=k,
-        n=n,
-        k_pad=k_pad,
-        interpret=bool(interpret),
-    )
+    with dispatch_span("kernels.pallas_sampler", chains=int(B_pad)) as _ds:
+        panels, ok = _pallas_sample(
+            A_d,
+            AT_d,
+            qmin_d,
+            qmax_d,
+            sc,
+            jnp.asarray(hh),
+            seed,
+            B=B_pad,
+            block_b=block_b,
+            k=k,
+            n=n,
+            k_pad=k_pad,
+            interpret=bool(interpret),
+        )
+        _ds.out = (panels, ok)
     return panels[:B], ok[:B]

@@ -38,7 +38,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "graftlint: static analysis of this repo's JAX invariants "
             "(R1 host-sync-in-jit, R2 jit-per-call, R3 donated-buffer-reuse, "
             "R4 dtype-discipline, R5 tracer-branch, R6 config-knob-hygiene, "
-            "R7 thread-discipline). Suppress with "
+            "R7 thread-discipline, R8 core-span-coverage). Suppress with "
             "'# graftlint: disable=R1 -- reason'; a suppression that matches "
             "no finding is itself an error. --ir runs the jaxpr/HLO-level "
             "verifier over the registered hot cores instead."

@@ -174,9 +174,10 @@ def iter_python_files(paths: Iterable[Path]) -> List[Path]:
 
 
 def all_rules():
-    """The registered rule set, R1..R7 (R0 is emitted by the engine itself)."""
+    """The registered rule set, R1..R8 (R0 is emitted by the engine itself)."""
     from citizensassemblies_tpu.lint.config_rule import ConfigKnobRule
     from citizensassemblies_tpu.lint.rules import (
+        CoreSpanRule,
         DonatedBufferReuseRule,
         DtypeDisciplineRule,
         HostSyncInJitRule,
@@ -193,6 +194,7 @@ def all_rules():
         TracerBranchRule(),
         ConfigKnobRule(),
         ThreadDisciplineRule(),
+        CoreSpanRule(),
     ]
 
 

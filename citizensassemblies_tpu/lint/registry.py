@@ -61,6 +61,14 @@ class CoreEntry:
     core is the ELL twin of, registered at the SAME problem shape — the IR
     pass then emits a measured dense→sparse flops/bytes delta for the pair
     into the budget-diff artifact (``lint.ir.budget_diff``).
+
+    ``span``/``span_optout`` are the grafttrace wiring contract (graftlint
+    R8): ``span`` names the ``obs.hooks.dispatch_span`` that wraps this
+    core's public entry point (the name must appear in a ``dispatch_span``
+    call in the registering module); ``span_optout`` is the explicit
+    reasoned exemption for cores with no runtime entry of their own (e.g.
+    a dense IR comparator whose production dispatch rides another core's
+    span).
     """
 
     name: str
@@ -68,6 +76,8 @@ class CoreEntry:
     line: int  # line of the builder (file:line in PASS/FAIL output)
     build: Callable[[], IRCase]
     dense_ref: Optional[str] = None
+    span: Optional[str] = None
+    span_optout: Optional[str] = None
 
 
 #: name -> entry, populated by importing the MANIFEST modules
@@ -99,7 +109,12 @@ def _rel_path(file: str) -> str:
         return str(p)
 
 
-def register_ir_core(name: str, dense_ref: Optional[str] = None) -> Callable:
+def register_ir_core(
+    name: str,
+    dense_ref: Optional[str] = None,
+    span: Optional[str] = None,
+    span_optout: Optional[str] = None,
+) -> Callable:
     """Decorator: register ``build`` as the lazy IRCase builder for ``name``.
 
     The decorated function takes no arguments and returns an :class:`IRCase`;
@@ -107,6 +122,9 @@ def register_ir_core(name: str, dense_ref: Optional[str] = None) -> Callable:
     registration's ``file:line`` is what the verifier reports for this core.
     ``dense_ref`` marks this core as the structured-sparse (ELL) twin of a
     dense core registered at the same shape (see :class:`CoreEntry`).
+    ``span`` names the ``dispatch_span`` wrapping the core's entry point;
+    ``span_optout`` is the reasoned exemption — graftlint R8 requires
+    exactly one of the two on every registration.
     """
 
     def deco(build: Callable[[], IRCase]) -> Callable[[], IRCase]:
@@ -117,6 +135,8 @@ def register_ir_core(name: str, dense_ref: Optional[str] = None) -> Callable:
             line=build.__code__.co_firstlineno,
             build=build,
             dense_ref=dense_ref,
+            span=span,
+            span_optout=span_optout,
         )
         return build
 

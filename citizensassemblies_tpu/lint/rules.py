@@ -922,3 +922,118 @@ class TracerBranchRule:
                         )
                     )
         return out
+
+
+class CoreSpanRule:
+    """R8 — every ``@register_ir_core``-registered hot core must be wired
+    into grafttrace: the registration declares ``span="<name>"`` and the
+    SAME module contains a ``dispatch_span("<name>", …)`` call wrapping the
+    core's public entry point, OR it declares ``span_optout="reason"`` (a
+    core with no runtime entry of its own — e.g. a dense IR comparator
+    whose production dispatch rides another core's span).
+
+    The IR manifest is the repo's authoritative list of hot jitted cores;
+    a core that can burn device time without appearing in a request's trace
+    is exactly the observability gap this PR exists to close, so the
+    checklist is enforced the same way the manifest itself is (statically,
+    per registration site). Span names are matched against the string
+    constants inside ``dispatch_span(...)`` calls — a conditional name
+    (``"a" if exact else "b"``) matches both literals.
+    """
+
+    rule_id = "R8"
+    name = "core-span-coverage"
+    description = "registered IR cores must declare a dispatch span or opt out"
+
+    @staticmethod
+    def _register_calls(mod: ModuleSource) -> List[ast.Call]:
+        out = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is not None and d.rsplit(".", 1)[-1] == "register_ir_core":
+                    out.append(node)
+        return out
+
+    @staticmethod
+    def _span_literals(mod: ModuleSource) -> Set[str]:
+        """String constants appearing inside ``dispatch_span(...)`` calls."""
+        names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None or d.rsplit(".", 1)[-1] != "dispatch_span":
+                continue
+            if node.args:
+                for c in ast.walk(node.args[0]):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                        names.add(c.value)
+        return names
+
+    def check_module(self, mod: ModuleSource) -> List[Violation]:
+        regs = self._register_calls(mod)
+        if not regs:
+            return []
+        spans_here = self._span_literals(mod)
+        out: List[Violation] = []
+
+        def flag(node: ast.AST, message: str) -> None:
+            out.append(
+                Violation(
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    rule=self.rule_id, name=self.name, message=message,
+                )
+            )
+
+        for call in regs:
+            core = None
+            if call.args and isinstance(call.args[0], ast.Constant):
+                core = call.args[0].value
+            kw = {k.arg: k.value for k in call.keywords}
+            span_v = kw.get("span")
+            opt_v = kw.get("span_optout")
+            if span_v is None and opt_v is None:
+                flag(
+                    call,
+                    f"registered core {core!r} is not traced: declare "
+                    "span=\"<name>\" (and wrap the entry point in "
+                    "dispatch_span) or span_optout=\"reason\"",
+                )
+                continue
+            if span_v is not None and opt_v is not None:
+                flag(
+                    call,
+                    f"registered core {core!r} declares BOTH span= and "
+                    "span_optout= — pick one",
+                )
+                continue
+            if opt_v is not None:
+                if not (
+                    isinstance(opt_v, ast.Constant)
+                    and isinstance(opt_v.value, str)
+                    and opt_v.value.strip()
+                ):
+                    flag(
+                        call,
+                        f"registered core {core!r}: span_optout needs a "
+                        "non-empty literal reason",
+                    )
+                continue
+            if not (
+                isinstance(span_v, ast.Constant) and isinstance(span_v.value, str)
+            ):
+                flag(
+                    call,
+                    f"registered core {core!r}: span= must be a string literal",
+                )
+                continue
+            if span_v.value not in spans_here:
+                flag(
+                    call,
+                    f"registered core {core!r} declares span="
+                    f"'{span_v.value}' but no dispatch_span('{span_v.value}', "
+                    "…) call exists in this module — wrap the entry point "
+                    "(obs.hooks.dispatch_span)",
+                )
+        return out

@@ -36,6 +36,7 @@ import numpy as np
 
 from citizensassemblies_tpu.core.instance import DenseInstance, SelectionError
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.ops.pairs import pair_matrix_from_panels
 from citizensassemblies_tpu.utils.config import Config, default_config
 
@@ -178,7 +179,7 @@ def _sample_panels_kernel(
     return panels, ~failed
 
 
-@register_ir_core("legacy.scan_sampler")
+@register_ir_core("legacy.scan_sampler", span="legacy.scan_sampler")
 def _ir_scan_sampler() -> IRCase:
     """The scan-path batch draw at a small (n=40, F=12, k=6, B=32) shape —
     the per-step matmuls and the per-chain fold_in key stream are the
@@ -238,7 +239,10 @@ def sample_panels_batch(
         return sample_panels_pallas(dense, key, batch, scores=scores, households=households)
     if sampler != "scan":
         raise ValueError(f"unknown sampler {sampler!r}: expected 'auto', 'pallas' or 'scan'")
-    return _sample_panels_kernel(dense, key, batch, scores, households)
+    with dispatch_span("legacy.scan_sampler", chains=int(batch)) as _ds:
+        out = _sample_panels_kernel(dense, key, batch, scores, households)
+        _ds.out = out
+    return out
 
 
 def sample_feasible_panels(

@@ -63,7 +63,7 @@ from citizensassemblies_tpu.service.context import (
 )
 from citizensassemblies_tpu.utils.config import Config
 from citizensassemblies_tpu.utils.logging import RunLog
-from citizensassemblies_tpu.utils.profiling import format_counters, format_timers
+from citizensassemblies_tpu.obs.metrics import format_counters, format_timers
 
 
 @dataclasses.dataclass

@@ -1,0 +1,61 @@
+"""grafttrace: unified observability for the selection stack.
+
+Three layers, all stdlib-importable (jax only touched lazily, and only in
+the opt-in device-sampling mode):
+
+* ``obs.trace`` — nested span tracer, ambient via ContextVar and integrated
+  with the service's per-request ``RequestContext``; exports Chrome
+  trace-event / Perfetto JSON per run;
+* ``obs.metrics`` — typed metrics registry (Counter/Gauge/Timer/Histogram
+  with label sets) that ``RunLog.count``/``gauge``/``timer`` delegate to
+  bit-compatibly, plus the Prometheus text renderer and the in-band
+  ``format_timers``/``format_counters`` (absorbed from ``utils/profiling``);
+* ``obs.hooks`` — ``dispatch_span``, the device-dispatch timing hook every
+  registered IR core's entry point wraps (graftlint R8), tri-stated by
+  ``Config.obs_trace``;
+* ``obs.trend`` — the ``bench.py --trend`` regression gate over the
+  committed BENCH_*.json trajectory.
+"""
+
+from citizensassemblies_tpu.obs.hooks import DispatchScope, dispatch_span
+from citizensassemblies_tpu.obs.metrics import (
+    MetricsRegistry,
+    format_counters,
+    format_timers,
+)
+from citizensassemblies_tpu.obs.trace import (
+    TRACE_SCHEMA_VERSION,
+    Span,
+    Tracer,
+    begin_span,
+    current_tracer,
+    end_span,
+    export_chrome_trace,
+    span,
+    span_coverage,
+    use_tracer,
+    validate_chrome_trace,
+)
+from citizensassemblies_tpu.obs.trend import TrendReport, collect_series, trend_gate
+
+__all__ = [
+    "DispatchScope",
+    "dispatch_span",
+    "MetricsRegistry",
+    "format_counters",
+    "format_timers",
+    "TRACE_SCHEMA_VERSION",
+    "Span",
+    "Tracer",
+    "begin_span",
+    "current_tracer",
+    "end_span",
+    "export_chrome_trace",
+    "span",
+    "span_coverage",
+    "use_tracer",
+    "validate_chrome_trace",
+    "TrendReport",
+    "collect_series",
+    "trend_gate",
+]

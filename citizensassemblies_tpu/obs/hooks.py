@@ -1,0 +1,67 @@
+"""grafttrace device-dispatch hooks: span-wrap the jitted hot calls.
+
+Every hot core's public entry point wraps its device dispatch in
+:func:`dispatch_span` (graftlint R8 checks the wiring against the IR-core
+manifest). The hook is tri-stated by ``Config.obs_trace``:
+
+* ``False`` — hard off: inert even with a tracer installed (one attribute
+  read), bit-identical, zero allocation on the shared scope;
+* ``None`` (auto) — a span records whenever a tracer is ambient (or rides
+  the given ``log``), measuring the HOST-side dispatch window only: the
+  call may return an unrealized device array, so the span is enqueue
+  latency, which is the honest number for pipelined callers;
+* ``True`` (the sampling mode, carried by ``Tracer.sample_device``) — the
+  hook additionally ``jax.block_until_ready``-s whatever the caller stored
+  in ``scope.out``, so the span measures device EXECUTION. Blocking is a
+  wait, not a transfer — numerics, counters and guard semantics are
+  untouched (the obs-on/off bit-identity test pins it) — but it serializes
+  async pipelines, which is why it is opt-in.
+
+Usage::
+
+    with dispatch_span("lp_pdhg.pdhg_core", cfg=cfg, log=log, nv=nv) as ds:
+        out = core(*operands)
+        ds.out = out
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from citizensassemblies_tpu.obs.trace import _resolve
+
+
+class DispatchScope:
+    """Mutable slot the caller parks its device outputs in; the hook blocks
+    on them at scope exit in sampling mode."""
+
+    __slots__ = ("out",)
+
+    def __init__(self):
+        self.out = None
+
+
+#: shared inert scope handed out when tracing is off — callers only ever
+#: WRITE ``.out`` (never read), so sharing it across threads is harmless
+#: and keeps the off path allocation-free
+_INERT = DispatchScope()
+
+
+@contextmanager
+def dispatch_span(name: str, cfg=None, log=None, **attrs):
+    if cfg is not None and getattr(cfg, "obs_trace", None) is False:
+        yield _INERT
+        return
+    tr = _resolve(log)
+    if tr is None:
+        yield _INERT
+        return
+    scope = DispatchScope()
+    with tr.span(name, kind="dispatch", **attrs) as sp:
+        yield scope
+        if tr.sample_device and scope.out is not None:
+            import jax
+
+            jax.block_until_ready(scope.out)
+            if sp is not None:
+                sp.attrs["sampled"] = True

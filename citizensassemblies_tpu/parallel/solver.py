@@ -44,6 +44,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.parallel.mesh import shard_map_compat
 from citizensassemblies_tpu.solvers.highs_backend import DualSolution
 from citizensassemblies_tpu.utils.config import Config, default_config
@@ -347,7 +348,7 @@ def _get_sharded_jit_ell(mesh: Mesh, block_iters: int, max_blocks: int):
     return core
 
 
-@register_ir_core("parallel.sharded_dual_lp")
+@register_ir_core("parallel.sharded_dual_lp", span="parallel.sharded_dual_lp")
 def _ir_sharded_dual_lp() -> IRCase:
     """The mesh-sharded dual-LP solve on a deterministic ONE-device mesh:
     per-shard shapes must not depend on how many devices the verifying host
@@ -367,7 +368,11 @@ def _ir_sharded_dual_lp() -> IRCase:
     )
 
 
-@register_ir_core("parallel.sharded_dual_lp_ell", dense_ref="parallel.sharded_dual_lp")
+@register_ir_core(
+    "parallel.sharded_dual_lp_ell",
+    dense_ref="parallel.sharded_dual_lp",
+    span="parallel.sharded_dual_lp_ell",
+)
 def _ir_sharded_dual_lp_ell() -> IRCase:
     """The ELL twin at the dense registration's (rows, nv) shape, packed at
     k_pad = 8 slots — same one-device mesh so the budgets stay
@@ -424,8 +429,13 @@ def _run_core(
     # exactly the per-round host-side re-layout this path exists to avoid
     from citizensassemblies_tpu.utils.guards import no_implicit_transfers
 
-    with no_implicit_transfers(cfg):
-        return core(G_dev, h_dev, c_dev, a_dev, b_dev, tol_dev)
+    with dispatch_span(
+        "parallel.sharded_dual_lp", cfg=cfg, rows=int(G.shape[0])
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            out = core(G_dev, h_dev, c_dev, a_dev, b_dev, tol_dev)
+        _ds.out = out
+    return out
 
 
 def _run_core_ell(
@@ -458,8 +468,13 @@ def _run_core_ell(
     tol_dev = jax.device_put(np.asarray([tol], np.float32), rep_sharding)
     from citizensassemblies_tpu.utils.guards import no_implicit_transfers
 
-    with no_implicit_transfers(cfg):
-        return core(idx_dev, val_dev, h_dev, c_dev, a_dev, b_dev, tol_dev)
+    with dispatch_span(
+        "parallel.sharded_dual_lp_ell", cfg=cfg, rows=int(idx.shape[0])
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            out = core(idx_dev, val_dev, h_dev, c_dev, a_dev, b_dev, tol_dev)
+        _ds.out = out
+    return out
 
 
 def solve_dual_lp_pdhg_sharded(

@@ -24,6 +24,7 @@ import numpy as np
 
 from citizensassemblies_tpu.core.instance import DenseInstance
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 
 #: memoized jitted sweep core — one traced program per (k, padded shape)
 #: via the jit cache, instead of re-tracing the vmap on every sweep call
@@ -61,7 +62,7 @@ def _get_sweep_alloc_core():
     return _SWEEP_ALLOC_CORE
 
 
-@register_ir_core("sweep.alloc_core")
+@register_ir_core("sweep.alloc_core", span="sweep.alloc_core")
 def _ir_sweep_alloc_core() -> IRCase:
     """A two-instance padded sweep at the scan sampler's small shape — the
     whole estimator fleet as one device program (lint/ir.py)."""
@@ -131,7 +132,9 @@ def sweep_legacy_allocations(
     # one jitted program per (k, padded shape): the memoized core batches
     # every array leaf; static fields (k, n_categories) ride along as aux
     core = _get_sweep_alloc_core()
-    alloc, rate = core(batched, keys, B=int(chains_per_instance))
+    with dispatch_span("sweep.alloc_core", instances=len(denses)) as _ds:
+        alloc, rate = core(batched, keys, B=int(chains_per_instance))
+        _ds.out = (alloc, rate)
     return np.asarray(alloc, dtype=np.float64), np.asarray(rate, dtype=np.float64)
 
 

@@ -35,6 +35,7 @@ from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.logging import RunLog
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
+    from citizensassemblies_tpu.obs.trace import Tracer
     from citizensassemblies_tpu.service.batcher import CrossRequestBatcher
     from citizensassemblies_tpu.service.session import TenantSession
     from citizensassemblies_tpu.solvers.batch_lp import WarmSlotStore
@@ -81,6 +82,11 @@ class RequestContext:
     warm_store: Optional["WarmSlotStore"] = None
     session: Optional["TenantSession"] = None
     batcher: Optional["CrossRequestBatcher"] = None
+    #: per-request grafttrace tracer (``obs.trace``): installed as the
+    #: AMBIENT tracer for the request's scope by :func:`use_context`, so
+    #: concurrent requests produce disjoint, well-nested span trees — the
+    #: trace-isolation contract ``tests/test_obs.py`` pins
+    tracer: Optional["Tracer"] = None
 
     @classmethod
     def create(
@@ -120,9 +126,21 @@ def use_context(ctx: Optional[RequestContext]):
         yield None
         return
     token = _ACTIVE.set(ctx)
+    trace_token = None
+    if ctx.tracer is not None:
+        # install the request's tracer on the same ContextVar mechanics as
+        # the context itself — per-thread/per-task, so concurrent requests'
+        # spans cannot interleave into each other's traces
+        from citizensassemblies_tpu.obs.trace import activate_tracer
+
+        trace_token = activate_tracer(ctx.tracer)
     try:
         yield ctx
     finally:
+        if trace_token is not None:
+            from citizensassemblies_tpu.obs.trace import deactivate_tracer
+
+            deactivate_tracer(trace_token)
         _ACTIVE.reset(token)
 
 

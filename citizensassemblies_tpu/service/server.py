@@ -41,6 +41,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from citizensassemblies_tpu.obs.metrics import MetricsRegistry
 from citizensassemblies_tpu.service.batcher import CrossRequestBatcher
 from citizensassemblies_tpu.service.context import (
     RequestContext,
@@ -186,6 +187,21 @@ class SelectionService:
         self._completed = 0
         self._failed = 0
         self._memo_served = 0
+        # --- grafttrace observability (citizensassemblies_tpu/obs) --------
+        #: the fleet-level typed metrics registry: per-tenant request
+        #: counters, queue/batcher gauges, request-latency histogram —
+        #: rendered by metrics_text() (Prometheus) and streamed as periodic
+        #: ("metrics", …) channel events by the snapshot loop below
+        self.metrics = MetricsRegistry(
+            max_label_sets=int(getattr(self.cfg, "obs_max_label_sets", 64))
+        )
+        #: open channels the snapshot loop broadcasts into (rid → channel)
+        self._channels: Dict[str, ResultChannel] = {}
+        #: finished per-request tracers, newest last (bounded retention) —
+        #: export_traces() merges them into one Chrome trace document
+        self._traces: List[Any] = []
+        self._snap_stop = threading.Event()
+        self._snap_thread: Optional[threading.Thread] = None
 
     # --- public API ---------------------------------------------------------
 
@@ -193,6 +209,10 @@ class SelectionService:
         """Admit one request; returns its streaming channel immediately."""
         with self._lock:
             if self._in_flight >= self.queue_depth:
+                self.metrics.counter(
+                    "graftserve_admission_rejected_total",
+                    help="submissions refused by back-pressure",
+                ).inc()
                 raise AdmissionError(
                     f"queue full: {self._in_flight} requests in flight "
                     f"(serve_queue_depth={self.queue_depth})"
@@ -200,6 +220,9 @@ class SelectionService:
             self._in_flight += 1
         rid = request.request_id or _next_request_id()
         channel = ResultChannel(rid)
+        with self._lock:
+            self._channels[rid] = channel
+        self._ensure_snapshot_loop()
         self._pool.submit(self._run_request, request, rid, channel)
         return channel
 
@@ -219,7 +242,94 @@ class SelectionService:
         out["tenants"] = self.tenants.all_stats()
         return out
 
+    # --- observability (grafttrace) -----------------------------------------
+
+    def _ensure_snapshot_loop(self) -> None:
+        """Start the periodic metrics-snapshot broadcaster lazily (first
+        submission), when ``Config.obs_metrics_interval_s`` > 0. One daemon
+        thread per service; every open ResultChannel receives a
+        ``("metrics", snapshot)`` progress event per tick, so a streaming
+        client sees queue depth / fusion ratio / eviction pressure evolve
+        while its own request runs."""
+        interval = float(getattr(self.cfg, "obs_metrics_interval_s", 0.0) or 0.0)
+        if interval <= 0:
+            return
+        with self._lock:
+            if self._snap_thread is not None:
+                return
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_loop,
+                args=(interval,),
+                daemon=True,
+                name="graftserve-metrics",
+            )
+            self._snap_thread.start()
+
+    def _snapshot_loop(self, interval: float) -> None:
+        while not self._snap_stop.wait(interval):
+            snap = self.metrics_snapshot()
+            with self._lock:
+                channels = list(self._channels.values())
+            for ch in channels:
+                ch.push("metrics", snap)
+
+    def _refresh_gauges(self) -> None:
+        """Fold the service's derived state into the registry's gauges —
+        called before every snapshot/render so scrapes are current."""
+        st = self.stats()
+        m = self.metrics
+        m.gauge("graftserve_in_flight", help="admitted, unfinished requests").set(
+            st["in_flight"]
+        )
+        m.gauge("graftserve_queue_depth", help="admission cap (config)").set(
+            self.queue_depth
+        )
+        b = st["batcher"]
+        m.gauge(
+            "graftserve_batcher_fusion_ratio",
+            help="fused dispatches / dispatches (cross-request batching)",
+        ).set(
+            round(b.get("fused_dispatches", 0) / max(b.get("dispatches", 0), 1), 4)
+        )
+        m.gauge(
+            "graftserve_batcher_solves_per_dispatch",
+            help="cross-request occupancy",
+        ).set(round(b.get("solves", 0) / max(b.get("dispatches", 0), 1), 2))
+        from citizensassemblies_tpu.utils.memo import memo_evictions_by_owner
+
+        for owner, n in memo_evictions_by_owner().items():
+            m.gauge(
+                "graftserve_tenant_evictions",
+                help="LRU evictions attributed per owner",
+                labelnames=("owner",),
+            ).labels(owner=owner).set(n)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Structured fleet snapshot: the typed registry plus the raw
+        service/batcher/tenant stats (the periodic channel event payload)."""
+        self._refresh_gauges()
+        snap = self.metrics.snapshot()
+        snap["service"] = self.stats()
+        snap["ts"] = time.time()
+        return snap
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the fleet registry — the scrape
+        dump ``bench.py --serve`` writes next to its row."""
+        self._refresh_gauges()
+        return self.metrics.render_prometheus()
+
+    def export_traces(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """Merge the retained per-request tracers (obs_trace=True requests)
+        into one Chrome trace document — each request a process lane."""
+        from citizensassemblies_tpu.obs.trace import export_chrome_trace
+
+        with self._lock:
+            tracers = list(self._traces)
+        return export_chrome_trace(tracers, path=path)
+
     def shutdown(self, wait: bool = True) -> None:
+        self._snap_stop.set()
         self._pool.shutdown(wait=wait)
 
     def __enter__(self) -> "SelectionService":
@@ -246,6 +356,17 @@ class SelectionService:
         try:
             cfg = request.cfg or self.cfg
             log = _ChannelLog(channel)
+            # per-request tracing: obs_trace=True is the opt-in sampling
+            # mode — every request gets its OWN Tracer (disjoint traces by
+            # construction), installed ambiently by use_context below and
+            # carried on the log so worker threads (anchor pricer, batcher
+            # leader) attribute to the owning request
+            tracer = None
+            if getattr(cfg, "obs_trace", None) is True:
+                from citizensassemblies_tpu.obs.trace import Tracer
+
+                tracer = Tracer(name=rid, sample_device=True)
+                log.tracer = tracer
             session = self.tenants.session(request.tenant)
             ctx = RequestContext(
                 cfg=cfg,
@@ -255,6 +376,7 @@ class SelectionService:
                 warm_store=session.warm_store_for(rid),
                 session=session,
                 batcher=self.batcher,
+                tracer=tracer,
             )
             dense, space = self._featurize(request)
             fp = self._fingerprint(request, dense, cfg)
@@ -275,20 +397,47 @@ class SelectionService:
                 return
             with use_context(ctx):
                 with CompilationGuard(name=f"serve_{rid}", log=log) as guard:
-                    result = self._execute(request, dense, space, ctx, fp)
+                    if tracer is not None:
+                        with tracer.span(
+                            "request", algorithm=request.algorithm,
+                            tenant=request.tenant,
+                        ):
+                            result = self._execute(request, dense, space, ctx, fp)
+                    else:
+                        result = self._execute(request, dense, space, ctx, fp)
             session.memo_put((request.algorithm, fp), result)
             payload = self._finish(
                 request, rid, result, t0, ctx, compiles=guard.count
             )
+            if tracer is not None:
+                with self._lock:
+                    self._traces.append(tracer)
+                    del self._traces[:-64]  # bounded retention, newest kept
+            self.metrics.counter(
+                "graftserve_requests_total",
+                help="finished requests per tenant and algorithm",
+                labelnames=("tenant", "algorithm"),
+            ).labels(tenant=request.tenant, algorithm=request.algorithm).inc()
+            self.metrics.histogram(
+                "graftserve_request_seconds",
+                help="request sojourn time (submit to result)",
+            ).observe(time.monotonic() - t0)
             with self._lock:
                 self._completed += 1
                 self._in_flight -= 1
             channel.push("result", payload)
         except BaseException as exc:
+            self.metrics.counter(
+                "graftserve_failed_total", help="failed requests per tenant",
+                labelnames=("tenant",),
+            ).labels(tenant=request.tenant).inc()
             with self._lock:
                 self._failed += 1
                 self._in_flight -= 1
             channel.push("error", f"{type(exc).__name__}: {exc}")
+        finally:
+            with self._lock:
+                self._channels.pop(rid, None)
 
     def _fingerprint(self, request: SelectionRequest, dense, cfg: Config) -> str:
         from citizensassemblies_tpu.utils.checkpoint import problem_fingerprint
@@ -380,6 +529,14 @@ class SelectionService:
             audit["tenant_memo_evictions"] = memo_evictions_by_owner().get(
                 ctx.session.owner, 0
             )
+        if ctx.tracer is not None:
+            from citizensassemblies_tpu.obs.trace import TRACE_SCHEMA_VERSION
+
+            audit["obs"] = {
+                "span_count": ctx.tracer.span_count,
+                "dropped_spans": ctx.tracer.dropped,
+                "schema_version": TRACE_SCHEMA_VERSION,
+            }
         return RequestResult(
             request_id=rid,
             tenant=request.tenant,

@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
 from citizensassemblies_tpu.utils.memo import LRU
@@ -231,7 +232,7 @@ def _get_batch_core(max_iters: int, check_every: int):
     return core
 
 
-@register_ir_core("batch_lp.vmapped_core")
+@register_ir_core("batch_lp.vmapped_core", span="batch_lp.vmapped_core")
 def _ir_batch_core() -> IRCase:
     """One small (m1=64, m2=1, nv=65) bucket with a 4-lane batch — the
     vmapped while_loop carries the per-lane convergence masks, which is the
@@ -442,14 +443,19 @@ def solve_lp_batch(
             operands = tuple(
                 jnp.asarray(a) for a in (c, G, h, A, b, x0, lam0, mu0, tols)
             )
-        with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
-            with no_implicit_transfers(cfg):
-                x, lam, mu, it, res = core(*operands)
-            x = np.asarray(x, dtype=np.float64)
-            lam = np.asarray(lam, dtype=np.float64)
-            mu = np.asarray(mu, dtype=np.float64)
-            it = np.asarray(it)
-            res = np.asarray(res)
+        with dispatch_span(
+            "batch_lp.vmapped_core", cfg=cfg, log=log, bucket=bkey,
+            lanes=int(B_real),
+        ) as _ds:
+            with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
+                with no_implicit_transfers(cfg):
+                    x, lam, mu, it, res = core(*operands)
+                x = np.asarray(x, dtype=np.float64)
+                lam = np.asarray(lam, dtype=np.float64)
+                mu = np.asarray(mu, dtype=np.float64)
+                it = np.asarray(it)
+                res = np.asarray(res)
+            _ds.out = x
         with _STATS_LOCK:
             stats = _BUCKET_STATS.setdefault(
                 bkey, {"dispatches": 0, "solves": 0, "compiles": 0}
@@ -528,7 +534,11 @@ def _get_polish_screen_ell_core(max_iters: int, check_every: int):
     return core
 
 
-@register_ir_core("batch_lp.polish_screen_dense")
+@register_ir_core(
+    "batch_lp.polish_screen_dense",
+    span_optout="IR comparator only: the dense polish screen dispatches "
+    "through solve_lp_batch, whose batch_lp.vmapped_core span covers it",
+)
 def _ir_polish_screen_dense() -> IRCase:
     """The DENSE comparator of the ELL polish screen: the generic vmapped
     core at the stacked two-sided master shape (B=4 lanes of a T=128,
@@ -553,7 +563,11 @@ def _ir_polish_screen_dense() -> IRCase:
     )
 
 
-@register_ir_core("batch_lp.polish_screen_ell", dense_ref="batch_lp.polish_screen_dense")
+@register_ir_core(
+    "batch_lp.polish_screen_ell",
+    dense_ref="batch_lp.polish_screen_dense",
+    span="batch_lp.polish_screen_ell",
+)
 def _ir_polish_screen_ell() -> IRCase:
     """The ELL polish screen at the same (B=4, T=128, C=256) shape, packed
     at k_pad=16 slots — the production-representative fill."""
@@ -633,14 +647,19 @@ def solve_polish_screen_ell(
         jnp.asarray(colmask), jnp.asarray(x0), jnp.asarray(lam0),
         jnp.asarray(mu0), jnp.asarray(tols),
     )
-    with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
-        with no_implicit_transfers(cfg):
-            x, lam, mu, it, res = core(*operands)
-        x = np.asarray(x, dtype=np.float64)
-        lam = np.asarray(lam, dtype=np.float64)
-        mu = np.asarray(mu, dtype=np.float64)
-        it = np.asarray(it)
-        res = np.asarray(res)
+    with dispatch_span(
+        "batch_lp.polish_screen_ell", cfg=cfg, log=log, bucket=bkey,
+        lanes=int(B_real),
+    ) as _ds:
+        with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
+            with no_implicit_transfers(cfg):
+                x, lam, mu, it, res = core(*operands)
+            x = np.asarray(x, dtype=np.float64)
+            lam = np.asarray(lam, dtype=np.float64)
+            mu = np.asarray(mu, dtype=np.float64)
+            it = np.asarray(it)
+            res = np.asarray(res)
+        _ds.out = x
     with _STATS_LOCK:
         stats = _BUCKET_STATS.setdefault(
             bkey, {"dispatches": 0, "solves": 0, "compiles": 0}

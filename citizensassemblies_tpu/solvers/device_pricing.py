@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
 from citizensassemblies_tpu.solvers.pricing import beta_ladder
 from citizensassemblies_tpu.utils.config import Config
@@ -250,7 +251,7 @@ def _get_dp_core():
     return _DP_CORE
 
 
-@register_ir_core("device_pricing.greedy_lanes")
+@register_ir_core("device_pricing.greedy_lanes", span="device_pricing.greedy_lanes")
 def _ir_greedy_lanes() -> IRCase:
     """The β-ladder greedy pricer at one small (B=8 lanes, T=32 types, F=12
     features over 3 categories, k=8 slots) shape — integer scan state and the
@@ -268,7 +269,7 @@ def _ir_greedy_lanes() -> IRCase:
     )
 
 
-@register_ir_core("device_pricing.exact_dp")
+@register_ir_core("device_pricing.exact_dp", span="device_pricing.exact_dp")
 def _ir_exact_dp() -> IRCase:
     """The exact single-category DP at (B=4, T=16, k=8): the value-table
     scan plus the reverse backtrack scan."""
@@ -393,8 +394,14 @@ class DevicePricer:
                 self._qmin, self._qmax,
                 jnp.asarray(lane_w), jnp.asarray(lane_f),
             )
-        with no_implicit_transfers(self.cfg):
-            comps, ok = core(*operands, k=int(self.red.k))
+        with dispatch_span(
+            "device_pricing.exact_dp" if self.exact
+            else "device_pricing.greedy_lanes",
+            cfg=self.cfg, log=self.log, tasks=len(tasks), lanes=int(lanes),
+        ) as _ds:
+            with no_implicit_transfers(self.cfg):
+                comps, ok = core(*operands, k=int(self.red.k))
+            _ds.out = (comps, ok)
         return PricingHandle(
             comps=comps, ok=ok, tasks=list(tasks), lanes=lanes, exact=self.exact
         )

@@ -57,6 +57,8 @@ from typing import Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
+from citizensassemblies_tpu.obs.trace import begin_span, end_span
 from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
 from citizensassemblies_tpu.utils.logging import RunLog
@@ -238,7 +240,7 @@ def _get_fused_screen_core():
     return _FUSED_SCREEN_CORE
 
 
-@register_ir_core("face_decompose.fused_screen")
+@register_ir_core("face_decompose.fused_screen", span="face_decompose.fused_screen")
 def _ir_fused_screen() -> IRCase:
     """The fused (pair-selection-on-device) move screen at a small
     (T=32, F=40, one leftover category) shape — the top_k pair selection
@@ -263,7 +265,7 @@ def _ir_fused_screen() -> IRCase:
     )
 
 
-@register_ir_core("face_decompose.move_screen")
+@register_ir_core("face_decompose.move_screen", span="face_decompose.move_screen")
 def _ir_move_screen() -> IRCase:
     """The batched move screen at one small (T=32, F=40, one leftover
     category) shape — the uint32 bitmask lanes and the fixed-size nonzero
@@ -450,8 +452,12 @@ def _move_screen_dispatch(
             ns_lo, ns_hi, na_lo, na_hi, lf_ai, lf_aj, lf_donor,
         )
     )
-    with no_implicit_transfers(cfg):
-        idx, total = core(*operands, cap=int(per_round_cap))
+    with dispatch_span(
+        "face_decompose.move_screen", cfg=cfg, pairs=int(P)
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            idx, total = core(*operands, cap=int(per_round_cap))
+        _ds.out = (idx, total)
     return idx, total, Pp
 
 
@@ -689,11 +695,19 @@ class _FusedScreen:
             self._mask_lo, self._mask_hi, self._cand_di, self._cand_dj,
             self._lf_feat, self._lf_donor,
         )
-        with no_implicit_transfers(self.cfg):
-            idx, _total, ti, tj = core(
-                *operands, cap=self.cap, pool_cap=self.pool_cap,
-                face_pairs=self.face_pairs,
-            )
+        # NOTE: no ``.out`` is parked on the span scope — this dispatch is
+        # async BY DESIGN (it chains onto the master's in-flight duals and
+        # must not block even in the obs sampling mode), so its span
+        # measures the enqueue window only
+        with dispatch_span(
+            "face_decompose.fused_screen", cfg=self.cfg, rows=int(S),
+            async_chain=True,
+        ):
+            with no_implicit_transfers(self.cfg):
+                idx, _total, ti, tj = core(
+                    *operands, cap=self.cap, pool_cap=self.pool_cap,
+                    face_pairs=self.face_pairs,
+                )
         self._pending = (idx, ti, tj, comps)
         return True
 
@@ -1022,6 +1036,11 @@ def realize_profile(
     # explicitly passed) RequestContext; the context is (re)installed around
     # the round loop below so the batched-engine calls see it
     ctx, cfg, log = resolve_context(ctx, cfg, log)
+    # grafttrace: the pre-loop construction (seeding, screen/pricer init,
+    # pack state) as one open interval, so the phase's trace coverage is
+    # round spans + polish + this — no untraced gap before round 1. All
+    # span helpers are inert (None) when no tracer is installed.
+    _setup_span = begin_span("decomp_setup", log=log)
     T = reduction.T
     m = reduction.msize.astype(np.float64)
     if use_pdhg is None:
@@ -1096,6 +1115,7 @@ def realize_profile(
     if not cols:
         # nothing to decompose from (pathological seeding) — report failure
         # so the caller takes the stage-CG fallback
+        end_span(_setup_span, log=log)
         return np.zeros((0, T), np.int32), np.zeros(0), float("inf"), 0
 
     def polish_support(
@@ -1369,9 +1389,18 @@ def realize_profile(
     _guards = ExitStack()
     _guards.enter_context(use_context(ctx))
     _guards.enter_context(CompilationGuard("decomp", log=log))
+    end_span(_setup_span, log=log)
+    # grafttrace round tiling: consecutive OPEN intervals — each round's
+    # span ends where the next begins (begin_span/end_span, unstacked), so
+    # the loop's wall time is covered without re-indenting its body; the
+    # phase timers inside (decomp_master, decomp_oracle, decomp_expand,
+    # decomp_polish) record as sibling spans via RunLog.timer
+    _round_span = None
     try:
         for rnd in range(max_rounds):
             t_round = time.time()
+            end_span(_round_span, log=log)
+            _round_span = begin_span("decomp_round", log=log, round=rnd)
             # stall detection on the RUNNING BEST: the per-round arithmetic
             # eps of a first-order iterate wobbles +-30 %, and comparing raw
             # values made noisy upticks read as a stall while the hull was
@@ -1673,6 +1702,8 @@ def realize_profile(
                 break
 
         # out of rounds / stalled: one exact end-game solve on the best support
+        end_span(_round_span, log=log)
+        _round_span = None
         if best is not None and (len(p) != len(cols) or eps > accept):
             C_best, p_best, _ = best
             cols = [c for c in C_best]
@@ -1697,5 +1728,8 @@ def realize_profile(
         )
         return C_sup, p_sup, float(eps), lp_solves
     finally:
+        # a certified in-loop return leaves the current round span open —
+        # close it here (end_span is idempotent and None-safe)
+        end_span(_round_span, log=log)
         _guards.close()
         pricer.close()

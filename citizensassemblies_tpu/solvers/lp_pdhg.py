@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import no_implicit_transfers
 
@@ -255,11 +256,15 @@ def solve_lp(
     # tol would itself be an implicit transfer); inside the guard a stray
     # numpy operand re-uploaded per CG round raises
     tol_ = jnp.asarray(tol, jnp.float32)
-    with no_implicit_transfers(cfg):
-        x, lam, mu, it, res = _pdhg_core(
-            c_, G_, h_, A_, b_, x0, lam0, mu0, tol_,
-            max_iters=int(cfg.pdhg_max_iters), check_every=int(cfg.pdhg_check_every),
-        )
+    with dispatch_span(
+        "lp_pdhg.pdhg_core", cfg=cfg, nv=int(nv), m1=int(m1), m2=int(m2)
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            x, lam, mu, it, res = _pdhg_core(
+                c_, G_, h_, A_, b_, x0, lam0, mu0, tol_,
+                max_iters=int(cfg.pdhg_max_iters), check_every=int(cfg.pdhg_check_every),
+            )
+        _ds.out = (x, lam, mu, it, res)
     x = np.asarray(x, dtype=np.float64)
     lam = np.asarray(lam, dtype=np.float64)
     mu = np.asarray(mu, dtype=np.float64)
@@ -679,12 +684,16 @@ def solve_two_sided_master_async(
         jnp.asarray(mu0, f32),
         jnp.asarray(tol, jnp.float32),
     )
-    with no_implicit_transfers(cfg):
-        x, lam, mu, it, res = _pdhg_two_sided_core(
-            *operands,
-            max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
-            check_every=int(cfg.pdhg_check_every),
-        )
+    with dispatch_span(
+        "lp_pdhg.two_sided_core", cfg=cfg, T=int(T), cols=int(Cp)
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            x, lam, mu, it, res = _pdhg_two_sided_core(
+                *operands,
+                max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
+                check_every=int(cfg.pdhg_check_every),
+            )
+        _ds.out = (x, lam, mu, it, res)
     return MasterHandle(x=x, lam=lam, mu=mu, it=it, res=res, Cp=Cp, tol=tol)
 
 
@@ -768,12 +777,17 @@ def solve_two_sided_master_ell_async(
         jnp.asarray(mu0, f32),
         jnp.asarray(tol, jnp.float32),
     )
-    with no_implicit_transfers(cfg):
-        x, lam, mu, it, res = _pdhg_two_sided_core_ell(
-            *operands,
-            max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
-            check_every=int(cfg.pdhg_check_every),
-        )
+    with dispatch_span(
+        "lp_pdhg.two_sided_core_ell", cfg=cfg, T=int(T), cols=int(Cp),
+        k_pad=int(ell.k_pad),
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            x, lam, mu, it, res = _pdhg_two_sided_core_ell(
+                *operands,
+                max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
+                check_every=int(cfg.pdhg_check_every),
+            )
+        _ds.out = (x, lam, mu, it, res)
     return MasterHandle(x=x, lam=lam, mu=mu, it=it, res=res, Cp=Cp, tol=tol)
 
 
@@ -978,11 +992,15 @@ def solve_lp_ell(
     idx_d = jnp.asarray(ell.idx)
     val_d = jnp.asarray(ell.val)
     tol_ = jnp.asarray(tol, jnp.float32)
-    with no_implicit_transfers(cfg):
-        x, lam, mu, it, res = _pdhg_core_ell(
-            c_, idx_d, val_d, h_, A_, b_, x0, lam0, mu0, tol_,
-            max_iters=int(cfg.pdhg_max_iters), check_every=int(cfg.pdhg_check_every),
-        )
+    with dispatch_span(
+        "lp_pdhg.pdhg_core_ell", cfg=cfg, nv=int(nv), m1=int(m1), m2=int(m2)
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            x, lam, mu, it, res = _pdhg_core_ell(
+                c_, idx_d, val_d, h_, A_, b_, x0, lam0, mu0, tol_,
+                max_iters=int(cfg.pdhg_max_iters), check_every=int(cfg.pdhg_check_every),
+            )
+        _ds.out = (x, lam, mu, it, res)
     x = np.asarray(x, dtype=np.float64)
     lam = np.asarray(lam, dtype=np.float64)
     mu = np.asarray(mu, dtype=np.float64)
@@ -1011,7 +1029,7 @@ def solve_lp_ell(
 # (k_pad = 16 slots of T = 128 types).
 
 
-@register_ir_core("lp_pdhg.pdhg_core")
+@register_ir_core("lp_pdhg.pdhg_core", span="lp_pdhg.pdhg_core")
 def _ir_pdhg_core() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32 = jnp.float32
@@ -1028,7 +1046,11 @@ def _ir_pdhg_core() -> IRCase:
     )
 
 
-@register_ir_core("lp_pdhg.pdhg_core_ell", dense_ref="lp_pdhg.pdhg_core")
+@register_ir_core(
+    "lp_pdhg.pdhg_core_ell",
+    dense_ref="lp_pdhg.pdhg_core",
+    span="lp_pdhg.pdhg_core_ell",
+)
 def _ir_pdhg_core_ell() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32, i32 = jnp.float32, jnp.int32
@@ -1045,7 +1067,7 @@ def _ir_pdhg_core_ell() -> IRCase:
     )
 
 
-@register_ir_core("lp_pdhg.two_sided_core")
+@register_ir_core("lp_pdhg.two_sided_core", span="lp_pdhg.two_sided_core")
 def _ir_two_sided_core() -> IRCase:
     # T=128, C=256: the committed shape is shared with the ELL twin below so
     # the dense→sparse budget delta is a same-shape measurement
@@ -1063,7 +1085,11 @@ def _ir_two_sided_core() -> IRCase:
     )
 
 
-@register_ir_core("lp_pdhg.two_sided_core_ell", dense_ref="lp_pdhg.two_sided_core")
+@register_ir_core(
+    "lp_pdhg.two_sided_core_ell",
+    dense_ref="lp_pdhg.two_sided_core",
+    span="lp_pdhg.two_sided_core_ell",
+)
 def _ir_two_sided_core_ell() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32, i32 = jnp.float32, jnp.int32

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.memo import LRU
 
 
@@ -337,7 +338,7 @@ def _get_l2_fused_core_ell(
 # of n agents) the budget-diff's dense→sparse delta is measured at
 
 
-@register_ir_core("qp.l2_dual_ascent")
+@register_ir_core("qp.l2_dual_ascent", span="qp.l2_dual_ascent")
 def _ir_dual_ascent() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32 = jnp.float32
@@ -350,7 +351,11 @@ def _ir_dual_ascent() -> IRCase:
     )
 
 
-@register_ir_core("qp.l2_dual_ascent_ell", dense_ref="qp.l2_dual_ascent")
+@register_ir_core(
+    "qp.l2_dual_ascent_ell",
+    dense_ref="qp.l2_dual_ascent",
+    span="qp.l2_dual_ascent_ell",
+)
 def _ir_dual_ascent_ell() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32, i32 = jnp.float32, jnp.int32
@@ -366,7 +371,7 @@ def _ir_dual_ascent_ell() -> IRCase:
     )
 
 
-@register_ir_core("qp.l2_fused_core")
+@register_ir_core("qp.l2_fused_core", span="qp.l2_fused_core")
 def _ir_l2_fused() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32 = jnp.float32
@@ -380,7 +385,11 @@ def _ir_l2_fused() -> IRCase:
     )
 
 
-@register_ir_core("qp.l2_fused_core_ell", dense_ref="qp.l2_fused_core")
+@register_ir_core(
+    "qp.l2_fused_core_ell",
+    dense_ref="qp.l2_fused_core",
+    span="qp.l2_fused_core_ell",
+)
 def _ir_l2_fused_ell() -> IRCase:
     S = jax.ShapeDtypeStruct
     f32, i32 = jnp.float32, jnp.int32
@@ -536,20 +545,30 @@ def solve_final_primal_l2(
                         )
                         idx_j = jnp.asarray(ell.idx)
                         val_j = jnp.asarray(ell.val)
-                        with no_implicit_transfers(cfg):
-                            p_dev, pf_dev, _it_eps, _it_asc = fused_ell(
-                                idx_j, val_j, tj, dj,
-                                margin_dev, eps_tol_dev, asc_tol_dev,
-                            )
+                        with dispatch_span(
+                            "qp.l2_fused_core_ell", cfg=cfg, log=log,
+                            rows=int(P.shape[0]),
+                        ) as _ds:
+                            with no_implicit_transfers(cfg):
+                                p_dev, pf_dev, _it_eps, _it_asc = fused_ell(
+                                    idx_j, val_j, tj, dj,
+                                    margin_dev, eps_tol_dev, asc_tol_dev,
+                                )
+                            _ds.out = (p_dev, pf_dev)
                     else:
                         fused_dense = _get_l2_fused_core(
                             12_288, check_every, chunk, max_chunks
                         )
                         Pj = jnp.asarray(P, jnp.float32)
-                        with no_implicit_transfers(cfg):
-                            p_dev, pf_dev, _it_eps, _it_asc = fused_dense(
-                                Pj, tj, dj, margin_dev, eps_tol_dev, asc_tol_dev
-                            )
+                        with dispatch_span(
+                            "qp.l2_fused_core", cfg=cfg, log=log,
+                            rows=int(P.shape[0]),
+                        ) as _ds:
+                            with no_implicit_transfers(cfg):
+                                p_dev, pf_dev, _it_eps, _it_asc = fused_dense(
+                                    Pj, tj, dj, margin_dev, eps_tol_dev, asc_tol_dev
+                                )
+                            _ds.out = (p_dev, pf_dev)
                     # host materialization inside the timer (see bench.py:
                     # block_until_ready alone does not drain a TPU tunnel)
                     fused_p = np.asarray(p_dev, dtype=np.float64)
@@ -613,16 +632,24 @@ def solve_final_primal_l2(
             step_dev = jnp.asarray(1.0 / L, jnp.float32)
             if ell is not None:
                 lam0_ell = jnp.zeros((2 * tj.shape[0],), dtype=jnp.float32)
-                with no_implicit_transfers(cfg):
-                    p, _lam = _min_norm_dual_ascent_ell(
-                        idx_j, val_j, tj, eps_dev, step_dev, lam0_ell, iters
-                    )
+                with dispatch_span(
+                    "qp.l2_dual_ascent_ell", cfg=cfg, log=log, iters=int(iters)
+                ) as _ds:
+                    with no_implicit_transfers(cfg):
+                        p, _lam = _min_norm_dual_ascent_ell(
+                            idx_j, val_j, tj, eps_dev, step_dev, lam0_ell, iters
+                        )
+                    _ds.out = p
             else:
                 lam0 = jnp.zeros((2 * tj.shape[0],), dtype=jnp.float32)
-                with no_implicit_transfers(cfg):
-                    p, _lam = _min_norm_dual_ascent(
-                        Pj, tj, eps_dev, step_dev, lam0, iters
-                    )
+                with dispatch_span(
+                    "qp.l2_dual_ascent", cfg=cfg, log=log, iters=int(iters)
+                ) as _ds:
+                    with no_implicit_transfers(cfg):
+                        p, _lam = _min_norm_dual_ascent(
+                            Pj, tj, eps_dev, step_dev, lam0, iters
+                        )
+                    _ds.out = p
             # host materialization inside the timer: through a TPU tunnel,
             # block_until_ready alone does not drain the pipeline (see bench.py)
             p = np.asarray(p, dtype=np.float64)

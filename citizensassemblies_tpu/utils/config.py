@@ -300,6 +300,35 @@ class Config:
     #: reported on the request audit stamp.
     serve_tenant_memo_cap: int = 8
 
+    # --- observability (citizensassemblies_tpu/obs) ----------------------------
+    #: grafttrace span tracing, tri-state. ``False`` = hard off: the span
+    #: helpers and ``dispatch_span`` hooks are inert even with a tracer
+    #: installed — zero overhead, runs bit-identical to pre-trace builds,
+    #: the warm-rep compile bound unchanged. ``None`` (auto) = passive:
+    #: spans record whenever a caller installs a Tracer
+    #: (``obs.trace.use_tracer``); dispatch hooks never block, so dispatch
+    #: spans measure host enqueue latency. ``True`` = the SAMPLING mode:
+    #: the service creates a per-request Tracer and the dispatch hooks
+    #: ``block_until_ready`` their outputs so spans measure device
+    #: execution — numerically identical (a wait, not a transfer), but it
+    #: serializes async pipelines, hence opt-in.
+    obs_trace: Optional[bool] = None
+    #: seconds between the selection service's periodic metrics snapshots
+    #: (queue depth, in-flight, per-tenant evictions, batcher fusion ratio)
+    #: streamed as ``("metrics", …)`` events on every open ResultChannel.
+    #: 0 (the default) disables the snapshot thread entirely.
+    obs_metrics_interval_s: float = 0.0
+    #: per-instrument label-cardinality cap of the metrics registry: past
+    #: this many distinct label sets, new ones fold into one reserved
+    #: overflow series (counted) instead of growing without bound.
+    obs_max_label_sets: int = 64
+    #: ``bench.py --trend`` regression tolerance: a row FAILS when its
+    #: latest committed value exceeds tol × the best earlier round. Sized
+    #: so the committed BENCH trajectory's cross-container variance passes
+    #: while an injected 2× slowdown is flagged (tests/test_obs.py pins
+    #: both).
+    obs_trend_tol: float = 1.75
+
     # --- backends -------------------------------------------------------------
     #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),
     #: "highs" (host scipy/HiGHS LPs and MILPs — the cross-check backend), or

@@ -6,16 +6,33 @@ returns alongside results, and ``analyze_instance`` tees console output into
 ``analysis/<instance>_<k>_statistics.txt`` via a ``log()`` closure
 (``analysis.py:552-556``). ``RunLog`` preserves both behaviors behind one object.
 
+Metrics: ``count``/``gauge``/``timer`` delegate to a per-RunLog typed
+:class:`~citizensassemblies_tpu.obs.metrics.MetricsRegistry` — the
+grafttrace registry that also backs the service's Prometheus dump — with
+BIT-COMPATIBLE accessors: :attr:`counters` and :attr:`timers` return the
+same flat dicts (counters accumulate, gauges are latest-wins in the same
+namespace, timers accumulate seconds) as the pre-registry dict storage did,
+as defensive copies taken under the registry's mutation lock.
+
+Tracing: when a :class:`~citizensassemblies_tpu.obs.trace.Tracer` is
+active — ambient via ``obs.trace.use_tracer`` (the service installs one per
+request through its ``RequestContext``) or attached as ``self.tracer`` (so
+worker threads holding the request's log attribute correctly) — every
+``timer`` scope additionally records a SPAN of the same name, which is how
+the existing phase timers (``decomp_master``, ``stage_lp``, ``xmin_l2``…)
+become the trace tree without touching their call sites. With no tracer the
+timer path is the plain two-clock read it always was.
+
 Thread safety: the serving layer (``citizensassemblies_tpu/service``) runs
 CONCURRENT requests over solver code that mutates a RunLog's counter/timer
-dicts from whatever thread happens to be executing — including the engine-
-level logs the cross-request batcher updates from several requests' worker
-threads at once. ``dict.get``+store is not atomic under that load (two
-threads read the same old value and one increment is lost), so every mutation
-of ``lines``/``_timers``/``_counters`` takes the instance lock. The lock is
-uncontended in the single-threaded offline path (a few ns per count), and
-``tests/test_service.py`` hammers ``count()`` from a pool to pin the
-no-lost-increments contract.
+channels from whatever thread happens to be executing — including the
+engine-level logs the cross-request batcher updates from several requests'
+worker threads at once. ``dict.get``+store is not atomic under that load
+(two threads read the same old value and one increment is lost), so every
+mutation of ``lines`` takes the instance lock and every metrics mutation
+takes the registry lock. Both are uncontended in the single-threaded
+offline path (a few ns per count), and ``tests/test_service.py`` hammers
+``count()`` from a pool to pin the no-lost-increments contract.
 """
 
 from __future__ import annotations
@@ -27,6 +44,8 @@ from contextlib import contextmanager
 from pathlib import Path
 from typing import IO, List, Optional
 
+from citizensassemblies_tpu.obs.metrics import MetricsRegistry
+
 
 class RunLog:
     """Collects algorithm output lines; optionally echoes to stdout and a file."""
@@ -35,10 +54,14 @@ class RunLog:
         self.lines: List[str] = []
         self.echo = echo
         self.file = file
-        self._timers: dict[str, float] = {}
-        self._counters: dict[str, int] = {}
-        #: guards every mutation of lines/_timers/_counters — concurrent
-        #: requests in the serving layer count into shared engine logs
+        #: typed metrics registry behind count/gauge/timer (obs.metrics)
+        self.metrics = MetricsRegistry()
+        #: optional grafttrace Tracer: set by the service's RequestContext
+        #: (or a bench harness) so spans attribute to the owning request
+        #: even from worker threads; None = no tracing from this log
+        self.tracer = None
+        #: guards every mutation of lines — concurrent requests in the
+        #: serving layer emit into shared engine logs
         self._mutex = threading.Lock()
 
     def emit(self, message: str) -> str:
@@ -63,37 +86,40 @@ class RunLog:
 
     @contextmanager
     def timer(self, name: str):
+        """Accumulating phase timer; records a same-named span when a tracer
+        is active (``self.tracer`` or the ambient one — see module doc)."""
+        from citizensassemblies_tpu.obs.trace import _resolve
+
+        tracer = _resolve(self)
+        sp = tracer.begin(name, stacked=True) if tracer is not None else None
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
-            with self._mutex:
-                self._timers[name] = self._timers.get(name, 0.0) + dt
+            if tracer is not None:
+                tracer.end(sp)
+            self.metrics.timer(name).observe(dt)
 
     @property
     def timers(self) -> dict:
-        with self._mutex:
-            return dict(self._timers)
+        return self.metrics.flat_timers()
 
     def count(self, name: str, inc: int = 1) -> None:
         """Accumulate a named event counter (e.g. warm-start hits, overlap
         harvests) — the discrete sibling of :meth:`timer`, rendered by
-        :func:`citizensassemblies_tpu.utils.profiling.format_counters`."""
-        with self._mutex:
-            self._counters[name] = self._counters.get(name, 0) + inc
+        :func:`citizensassemblies_tpu.obs.metrics.format_counters`."""
+        self.metrics.counter(name).inc(inc)
 
     def gauge(self, name: str, value) -> None:
         """Record a point-in-time VALUE (latest wins, no accumulation) into
         the counters channel — e.g. the measured ELL fill ratio of the last
         pack, which a bench row wants as a level, not a sum."""
-        with self._mutex:
-            self._counters[name] = value
+        self.metrics.gauge(name).set(value)
 
     @property
     def counters(self) -> dict:
-        with self._mutex:
-            return dict(self._counters)
+        return self.metrics.flat_counters()
 
 
 @contextmanager

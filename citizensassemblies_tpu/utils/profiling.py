@@ -1,22 +1,29 @@
-"""Tracing / profiling (SURVEY §5).
+"""Thin compatibility shim over ``citizensassemblies_tpu.obs`` (SURVEY §5).
 
-The reference's only instrumentation is a wall-clock timing harness
-(``analysis.py:625-634``) and periodic progress prints. The TPU build adds:
+The tracing/metrics layer moved into the unified observability package:
 
-* :func:`profiler_trace` — wraps ``jax.profiler.trace`` so any region can be
-  captured for TensorBoard/Perfetto (XLA compile + device timelines).
-* :func:`annotate` — ``jax.profiler.TraceAnnotation`` context for named spans
-  inside a trace.
-* Per-phase wall timers live on :class:`~citizensassemblies_tpu.utils.logging.RunLog`
-  (``log.timer("dual_lp")``), which the solvers use to attribute CG time to
-  dual solves / pricing / exact certification; :func:`format_timers` renders
-  them for the in-band output-lines channel.
+* span tracing (nested spans, Chrome/Perfetto export) — ``obs.trace``;
+* ``format_timers``/``format_counters`` — ``obs.metrics`` (the registry
+  that now backs ``RunLog``'s channels);
+* device-dispatch timing hooks — ``obs.hooks.dispatch_span``.
+
+This module keeps the historical import surface stable (the in-band bench
+output format depends on the renderers) plus the two jax-profiler wrappers
+that predate grafttrace — ``profiler_trace`` captures a full XLA timeline
+for TensorBoard/Perfetto where grafttrace's host spans are not enough, and
+``annotate`` names regions inside such a capture.
 """
 
 from __future__ import annotations
 
 from contextlib import contextmanager, nullcontext
-from typing import Dict, Optional
+from typing import Optional
+
+# absorbed into the obs package; re-exported for existing imports
+from citizensassemblies_tpu.obs.metrics import (  # noqa: F401
+    format_counters,
+    format_timers,
+)
 
 
 @contextmanager
@@ -39,27 +46,3 @@ def annotate(name: str):
         return jax.profiler.TraceAnnotation(name)
     except Exception:  # pragma: no cover
         return nullcontext()
-
-
-def format_timers(timers: Dict[str, float]) -> str:
-    """One-line phase-time attribution, largest first."""
-    if not timers:
-        return "phase times: (none recorded)"
-    parts = [
-        f"{name} {secs:.2f}s"
-        for name, secs in sorted(timers.items(), key=lambda kv: -kv[1])
-    ]
-    return "phase times: " + ", ".join(parts)
-
-
-def format_counters(counters: Dict[str, int]) -> str:
-    """One-line phase-event attribution (warm-start hits, overlap harvests,
-    cold restarts — the pipelined decomposition's counterpart to the wall
-    timers), largest first."""
-    if not counters:
-        return "phase counters: (none recorded)"
-    parts = [
-        f"{name} {cnt}"
-        for name, cnt in sorted(counters.items(), key=lambda kv: -kv[1])
-    ]
-    return "phase counters: " + ", ".join(parts)

@@ -318,6 +318,82 @@ def test_r7_caller_thread_writes_not_flagged(tmp_path):
     assert "R7" not in _rules(report), render_report(report)
 
 
+def test_r8_unwired_core_flagged(tmp_path):
+    # a registered core with neither span= nor span_optout= is untraced
+    report = _lint(tmp_path, {"mod.py": (
+        "from citizensassemblies_tpu.lint.registry import register_ir_core\n"
+        "\n"
+        "@register_ir_core('mod.core')\n"
+        "def _ir_core():\n"
+        "    return None\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R8"]
+    assert viols, render_report(report)
+    assert "mod.core" in viols[0].message
+
+
+def test_r8_declared_span_must_exist_in_module(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from citizensassemblies_tpu.lint.registry import register_ir_core\n"
+        "\n"
+        "@register_ir_core('mod.core', span='mod.core')\n"
+        "def _ir_core():\n"
+        "    return None\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R8"]
+    assert viols, render_report(report)
+    assert "dispatch_span" in viols[0].message
+
+
+def test_r8_wired_span_and_optout_clean(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from citizensassemblies_tpu.lint.registry import register_ir_core\n"
+        "from citizensassemblies_tpu.obs.hooks import dispatch_span\n"
+        "\n"
+        "def entry(core, operands, exact):\n"
+        "    with dispatch_span('mod.core' if exact else 'mod.other') as ds:\n"
+        "        out = core(*operands)\n"
+        "        ds.out = out\n"
+        "    return out\n"
+        "\n"
+        "@register_ir_core('mod.core', span='mod.core')\n"
+        "def _ir_core():\n"
+        "    return None\n"
+        "\n"
+        "@register_ir_core('mod.other', span='mod.other')\n"
+        "def _ir_other():\n"
+        "    return None\n"
+        "\n"
+        "@register_ir_core('mod.twin', span_optout='IR comparator; rides mod.core')\n"
+        "def _ir_twin():\n"
+        "    return None\n"
+    )})
+    assert "R8" not in _rules(report), render_report(report)
+
+
+def test_r8_optout_needs_reason_and_not_both(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from citizensassemblies_tpu.lint.registry import register_ir_core\n"
+        "from citizensassemblies_tpu.obs.hooks import dispatch_span\n"
+        "\n"
+        "def entry(core):\n"
+        "    with dispatch_span('mod.b') as ds:\n"
+        "        ds.out = core()\n"
+        "\n"
+        "@register_ir_core('mod.a', span_optout='')\n"
+        "def _ir_a():\n"
+        "    return None\n"
+        "\n"
+        "@register_ir_core('mod.b', span='mod.b', span_optout='also this')\n"
+        "def _ir_b():\n"
+        "    return None\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R8"]
+    assert len(viols) == 2, render_report(report)
+    assert any("reason" in v.message for v in viols)
+    assert any("BOTH" in v.message for v in viols)
+
+
 # --- suppression syntax -----------------------------------------------------
 
 

@@ -1,0 +1,379 @@
+"""grafttrace: span tracing, typed metrics, and the bench trend gate.
+
+What is pinned here:
+
+* **Span nesting + schema** — nested spans export as valid Chrome
+  trace-event JSON (``validate_chrome_trace`` catches corrupted documents),
+  parent/child intervals are well-nested, and ``span_coverage`` measures
+  direct-child coverage of a root span.
+* **Concurrent-request trace isolation** — two interleaved requests, each
+  with its own ``RequestContext``-carried tracer, produce DISJOINT,
+  well-nested span trees: no span of one request lands in the other's
+  tracer (the ContextVar + per-log routing contract).
+* **Obs-off bitwise identity** — a tiny leximin run with ``obs_trace=True``
+  under a sampling tracer is bit-identical to the ``obs_trace=False`` run:
+  tracing may only observe, never perturb.
+* **RunLog bit-compatibility** — ``count``/``gauge``/``timer`` delegate to
+  the typed registry with the OLD dict semantics (accumulate / latest-wins
+  in one namespace / defensive copies).
+* **Label-cardinality cap** — past ``max_label_sets`` distinct label sets,
+  new ones fold into the reserved overflow series (counted) instead of
+  growing without bound.
+* **Trend gate** — ``trend_gate`` passes the repo's committed BENCH series
+  and flags a synthetic 2× slowdown injected as a newer round (both with
+  the default ``Config.obs_trend_tol``).
+* **Service metrics stream** — with ``obs_metrics_interval_s`` set, an
+  open ResultChannel receives periodic ``("metrics", …)`` events and the
+  Prometheus dump renders the fleet gauges.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from citizensassemblies_tpu.core.generator import random_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.obs import (
+    TRACE_SCHEMA_VERSION,
+    MetricsRegistry,
+    Tracer,
+    dispatch_span,
+    export_chrome_trace,
+    span_coverage,
+    use_tracer,
+    validate_chrome_trace,
+)
+from citizensassemblies_tpu.obs.trend import collect_series, trend_gate
+from citizensassemblies_tpu.service.context import RequestContext, use_context
+from citizensassemblies_tpu.utils.config import default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# --- span tracer -------------------------------------------------------------
+
+
+def test_span_nesting_schema_and_coverage():
+    tr = Tracer(name="t")
+    with use_tracer(tr):
+        with tr.span("root"):
+            with tr.span("child_a", phase=1):
+                time.sleep(0.01)
+            with tr.span("child_b"):
+                with tr.span("grandchild"):
+                    time.sleep(0.01)
+    spans = {s.name: s for s in tr.spans()}
+    assert spans["child_a"].parent_id == spans["root"].span_id
+    assert spans["child_b"].parent_id == spans["root"].span_id
+    assert spans["grandchild"].parent_id == spans["child_b"].span_id
+    # well-nested: every child interval sits inside its parent's
+    for child, parent in (
+        ("child_a", "root"), ("child_b", "root"), ("grandchild", "child_b"),
+    ):
+        assert spans[child].t0 >= spans[parent].t0
+        assert spans[child].t1 <= spans[parent].t1
+    # the two children tile most of the root
+    assert span_coverage(tr, "root") > 0.9
+    doc = export_chrome_trace([tr])
+    assert validate_chrome_trace(doc) == []
+    assert doc["schema_version"] == TRACE_SCHEMA_VERSION
+    x_events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in x_events} == {
+        "root", "child_a", "child_b", "grandchild",
+    }
+
+
+def test_trace_schema_validation_catches_corruption():
+    tr = Tracer(name="t")
+    with tr.span("only"):
+        pass
+    doc = export_chrome_trace([tr])
+    assert validate_chrome_trace(doc) == []
+    bad = json.loads(json.dumps(doc))
+    bad["traceEvents"].append({"ph": "X", "pid": 1, "tid": 1, "name": ""})
+    bad["traceEvents"].append({"ph": "Q", "pid": 1, "tid": 1, "name": "x"})
+    bad["schema_version"] = 999
+    problems = validate_chrome_trace(bad)
+    assert len(problems) >= 3
+    assert validate_chrome_trace("not a dict") == ["document is not an object"]
+
+
+def test_dispatch_span_inert_without_tracer_and_records_with():
+    cfg = default_config()
+    # no tracer: the shared inert scope, nothing recorded anywhere
+    with dispatch_span("core.test", cfg=cfg) as ds:
+        ds.out = 123
+    tr = Tracer(name="t")
+    with use_tracer(tr):
+        with dispatch_span("core.test", cfg=cfg, bucket="8x8") as ds:
+            ds.out = None
+        # hard-off wins over an installed tracer
+        with dispatch_span("core.off", cfg=cfg.replace(obs_trace=False)) as ds:
+            ds.out = None
+    names = [s.name for s in tr.spans()]
+    assert names == ["core.test"]
+    assert tr.spans()[0].attrs["bucket"] == "8x8"
+
+
+def test_runlog_timer_records_spans_only_when_traced():
+    log = RunLog(echo=False)
+    with log.timer("quiet"):
+        pass
+    tr = Tracer(name="t")
+    log.tracer = tr  # the worker-thread routing (no ambient install)
+    with tr.span("root"):
+        with log.timer("phase_x"):
+            time.sleep(0.005)
+    spans = {s.name: s for s in tr.spans()}
+    assert "quiet" not in spans
+    assert spans["phase_x"].parent_id == spans["root"].span_id
+    # the timer channel recorded both, traced or not
+    assert set(log.timers) == {"quiet", "phase_x"}
+
+
+def test_concurrent_request_trace_isolation():
+    """Two interleaved 'requests' (threads with their own contexts) must
+    produce disjoint, well-nested span trees."""
+    cfg = default_config()
+    tracers = {}
+    barrier = threading.Barrier(2, timeout=10)
+    errors = []
+
+    def request(rid: str):
+        try:
+            log = RunLog(echo=False)
+            tracer = Tracer(name=rid)
+            log.tracer = tracer
+            tracers[rid] = tracer
+            ctx = RequestContext.create(
+                cfg=cfg, log=log, request_id=rid, tenant=rid, tracer=tracer
+            )
+            with use_context(ctx):
+                with tracer.span(f"request_{rid}"):
+                    for i in range(5):
+                        barrier.wait()  # force true interleaving
+                        with log.timer(f"phase_{i}"):
+                            with dispatch_span(f"core_{rid}", cfg=cfg) as ds:
+                                ds.out = None
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+
+    t1 = threading.Thread(target=request, args=("A",))
+    t2 = threading.Thread(target=request, args=("B",))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert not errors
+    for rid in ("A", "B"):
+        other = "B" if rid == "A" else "A"
+        spans = tracers[rid].spans()
+        names = {s.name for s in spans}
+        # disjoint: nothing from the other request leaked in
+        assert f"core_{other}" not in names
+        assert f"request_{other}" not in names
+        assert f"core_{rid}" in names
+        # well-nested: every span closed, every phase under the request root
+        root = next(s for s in spans if s.name == f"request_{rid}")
+        assert all(s.t1 is not None for s in spans)
+        for s in spans:
+            if s.name.startswith("phase_"):
+                assert s.parent_id == root.span_id
+
+
+def test_obs_off_bitwise_identity_tiny_leximin():
+    dense, space = featurize(random_instance(n=48, k=6, n_categories=2, seed=3))
+    cfg_off = default_config().replace(obs_trace=False)
+    d_off = find_distribution_leximin(dense, space, cfg=cfg_off)
+    tr = Tracer(name="on", sample_device=True)
+    log = RunLog(echo=False)
+    log.tracer = tr
+    with use_tracer(tr):
+        d_on = find_distribution_leximin(
+            dense, space, cfg=default_config().replace(obs_trace=True), log=log
+        )
+    assert np.array_equal(d_off.allocation, d_on.allocation)
+    assert np.array_equal(d_off.fixed_probabilities, d_on.fixed_probabilities)
+    assert tr.span_count > 0  # the traced twin actually traced
+
+
+# --- metrics registry --------------------------------------------------------
+
+
+def test_runlog_registry_bitcompat():
+    log = RunLog(echo=False)
+    log.count("hits")
+    log.count("hits", 4)
+    log.gauge("fill_pct", 37)
+    # gauge into a counter's name replaces it; a later count resumes from it
+    log.gauge("hits", 10)
+    log.count("hits")
+    with log.timer("t"):
+        pass
+    with log.timer("t"):
+        pass
+    counters = log.counters
+    assert counters["hits"] == 11
+    assert counters["fill_pct"] == 37
+    assert set(log.timers) == {"t"}
+    # defensive copies: mutating the snapshot leaves the log untouched
+    counters["hits"] = -1
+    log.timers["t"] = -1.0
+    assert log.counters["hits"] == 11
+    assert log.timers["t"] >= 0.0
+
+
+def test_registry_label_cardinality_cap():
+    reg = MetricsRegistry(max_label_sets=3)
+    c = reg.counter("req_total", labelnames=("tenant",))
+    for i in range(10):
+        c.labels(tenant=f"t{i}").inc()
+    flat = reg.flat_counters()
+    # 3 real series + one overflow series absorbing the other 7
+    assert flat['req_total{overflow="true"}'] == 7
+    assert sum(1 for k in flat if k.startswith("req_total")) == 4
+    assert reg.label_overflow == 7
+    # known label sets keep counting into their own series
+    c.labels(tenant="t0").inc()
+    assert reg.flat_counters()['req_total{tenant="t0"}'] == 2
+
+
+def test_registry_prometheus_render_and_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("jobs_total", help="done jobs", labelnames=("tenant",)).labels(
+        tenant="a"
+    ).inc(3)
+    reg.gauge("depth").set(7)
+    reg.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(0.05)
+    reg.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(5.0)
+    with reg.timer("phase").time():
+        pass
+    text = reg.render_prometheus()
+    assert "# TYPE jobs_total counter" in text
+    assert 'jobs_total{tenant="a"} 3' in text
+    assert "depth 7" in text
+    assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "lat_seconds_count 2" in text
+    assert "phase_seconds_total" in text
+    snap = reg.snapshot()
+    assert snap["counters"]['jobs_total{tenant="a"}'] == 3
+    assert snap["gauges"]["depth"] == 7
+    assert snap["histograms"]["lat_seconds"]["count"] == 2
+
+
+def test_profiling_reexports_stay_stable():
+    # the dedup satellite: old import path must keep working
+    from citizensassemblies_tpu.obs.metrics import format_counters as new_fc
+    from citizensassemblies_tpu.utils.profiling import format_counters, format_timers
+
+    assert format_counters is new_fc
+    assert format_timers({"a": 2.0, "b": 1.0}).startswith("phase times: a 2.00s")
+
+
+# --- trend gate --------------------------------------------------------------
+
+
+def test_trend_passes_committed_series():
+    report = trend_gate(REPO_ROOT)
+    assert report.failures == [], [r.name for r in report.failures]
+    # the committed artifacts actually yielded multi-round series
+    gated = [r for r in report.rows if r.status in ("ok", "floor")]
+    assert len(gated) >= 5
+    doc = report.as_json()
+    assert doc["trend_ok"] is True and doc["schema_version"] == 1
+
+
+def test_trend_flags_injected_regression(tmp_path):
+    """Copy the committed series and append a synthetic round with a 2×
+    slowdown on every latest row — the gate must flag those rows (and the
+    untouched copy must still pass)."""
+    import shutil
+
+    for f in REPO_ROOT.glob("BENCH_r*.json"):
+        shutil.copy(f, tmp_path / f.name)
+    for f in REPO_ROOT.glob("BENCH_serve_r*.json"):
+        shutil.copy(f, tmp_path / f.name)
+    assert trend_gate(tmp_path).ok
+    series, rounds = collect_series(tmp_path)
+    nxt = max(rounds) + 1
+    slowed = {
+        name: pts[-1][1] * 2.0
+        for name, pts in series.items()
+        if len(pts) >= 1 and pts[-1][1] >= 1.0
+    }
+    assert slowed  # the committed series must offer something to regress
+    tail = json.dumps({name: {"seconds": v} for name, v in slowed.items()})
+    (tmp_path / f"BENCH_r{nxt:02d}.json").write_text(
+        json.dumps({"n": nxt, "cmd": "synthetic", "rc": 0, "tail": tail,
+                    "parsed": None})
+    )
+    report = trend_gate(tmp_path)
+    assert not report.ok
+    failed = {r.name for r in report.failures}
+    # every multi-point, above-floor row at 2× must trip the default tol
+    for name, pts in series.items():
+        if name in slowed and len(pts) >= 2:
+            prior_best = min(v for _r, v in pts)
+            if slowed[name] > default_config().obs_trend_tol * prior_best:
+                assert name in failed, name
+    assert failed  # at least one row actually gated
+
+
+def test_trend_recovers_rows_from_truncated_tails():
+    """The committed r03–r05 driver wrappers have ``parsed: null`` and
+    mid-JSON truncated tails; the regex recovery must still yield rows."""
+    series, rounds = collect_series(REPO_ROOT)
+    assert {3, 4, 5}.issubset(set(rounds))
+    assert any(
+        any(rnd in (3, 4, 5) for rnd, _v in pts) for pts in series.values()
+    )
+
+
+# --- service metrics stream --------------------------------------------------
+
+
+def test_service_metrics_stream_and_prometheus():
+    from citizensassemblies_tpu.service import SelectionRequest, SelectionService
+    from citizensassemblies_tpu.service.server import ResultChannel
+
+    cfg = default_config().replace(
+        obs_trace=True, obs_metrics_interval_s=0.02, serve_admission_cap=2
+    )
+    svc = SelectionService(cfg)
+    try:
+        insts = [
+            random_instance(n=40, k=5, n_categories=2, seed=s) for s in range(3)
+        ]
+        chans = [
+            svc.submit(SelectionRequest(instance=i, tenant=f"t{j % 2}"))
+            for j, i in enumerate(insts)
+        ]
+        # deterministic stream check: a registered open channel receives
+        # periodic ("metrics", …) ticks for as long as it stays open —
+        # independent of how fast the (jit-warm) tiny solves complete
+        probe = ResultChannel("probe")
+        with svc._lock:
+            svc._channels["probe"] = probe
+        snaps = []
+        deadline = time.time() + 10
+        while not snaps and time.time() < deadline:
+            time.sleep(0.02)
+            with probe._cond:
+                snaps = [p for k, p in probe._events if k == "metrics"]
+        with svc._lock:
+            svc._channels.pop("probe", None)
+        results = [ch.result(timeout=300) for ch in chans]
+        assert snaps, "no periodic metrics snapshot reached the open channel"
+        assert "service" in snaps[0] and "gauges" in snaps[0]
+        # per-request audit carries the obs block; traces merge + validate
+        assert all(r.audit.get("obs", {}).get("span_count", 0) > 0 for r in results)
+        doc = svc.export_traces()
+        assert validate_chrome_trace(doc) == []
+        assert len(doc["otherData"]["tracers"]) == 3
+        text = svc.metrics_text()
+        assert "graftserve_requests_total" in text
+        assert "graftserve_batcher_fusion_ratio" in text
+    finally:
+        svc.shutdown()
