@@ -1298,6 +1298,351 @@ def serve_bench(smoke_mode: bool = False) -> int:
     return 1 if failures else 0
 
 
+def chaos_bench(smoke_mode: bool = False) -> int:
+    """graftfault chaos bench: hammer the service with a FIXED, seeded fault
+    mix (``Config.fault_sites`` + ``fault_seed`` — the schedule is
+    crc-deterministic, so every run of this mode injects the identical
+    faults) and assert the hardening contract:
+
+    * every COMPLETED request still passes the 1e-3 L∞ exactness audit
+      (``contract_ok`` / ``realization_dev`` — degraded, retried and resumed
+      paths are certified by the same arithmetic check as the fast path);
+    * every injected fault class fired at least once AND shows up in the
+      recovery counters (quarantine / host re-solve / retry / degrade /
+      oracle-skip / leader-reclaim / resume);
+    * no request hangs past its deadline (every channel reaches a terminal
+      event within deadline + margin; a DeadlineExceeded rejection is a
+      VALID outcome — a hang or an unexplained failure is not).
+
+    Writes the full evidence to ``CHAOS_report.json`` (the CI ``chaos`` job
+    uploads it). ``--chaos --smoke`` is the CI variant (small fleet); plain
+    ``--chaos`` scales the fleet via ``BENCH_CHAOS_N``.
+    """
+    import tempfile
+
+    import numpy as np
+
+    from citizensassemblies_tpu.core.generator import random_instance, skewed_instance
+    from citizensassemblies_tpu.service import SelectionRequest, SelectionService
+    from citizensassemblies_tpu.utils.config import default_config
+
+    t_start = time.time()
+    failures = []
+    deadline_s = float(os.environ.get("BENCH_CHAOS_DEADLINE_S", "240"))
+    ckpt_dir = tempfile.mkdtemp(prefix="graftfault_ckpt_")
+    #: the fixed seeded SERVICE mix — the fault classes whose hot boundary
+    #: lives in the serving path; rates are tuned so every class fires
+    #: within the smoke fleet under fault_seed=7 (the schedule is
+    #: crc-deterministic: this is a pinned schedule, not luck). The solver-
+    #: boundary classes (oracle_raise, face_abort, warm_slot_corrupt,
+    #: qp_nan) are driven through their real entry points by the OFFLINE
+    #: passes below — under the service's production-seeded fleet the face
+    #: loop certifies at round 0 (the aimed-slice seed is that strong), so
+    #: they would not fire here at all
+    fault_mix = os.environ.get(
+        "BENCH_CHAOS_MIX",
+        "pdhg_nan:0.5,worker_crash:0.25,batcher_leader_death:0.2,"
+        "queue_stall:0.4",
+    )
+    cfg = default_config().replace(
+        lp_batch=True,
+        serve_batch_window_ms=8.0,
+        serve_admission_cap=4,
+        fault_sites=fault_mix,
+        fault_seed=7,
+        serve_deadline_s=deadline_s,
+        serve_retry_max=3,
+        serve_retry_backoff_s=0.02,
+        robust_checkpoint_every=1,
+        robust_checkpoint_dir=ckpt_dir,
+    )
+
+    # the fleet: mostly tiny mixed-shape requests (they exercise the batched
+    # engine + batcher + retry paths) plus face-loop instances (they exercise
+    # the anchor oracle, the per-round deadline gate and checkpoint/resume)
+    n_requests = 10 if smoke_mode else int(os.environ.get("BENCH_CHAOS_N", "24"))
+    specs = []
+    for i in range(n_requests):
+        if i % 5 == 4:
+            inst = skewed_instance(n=120, k=12, n_categories=3, seed=i % 3)
+        else:
+            inst = random_instance(
+                n=24 + 8 * (i % 3), k=4 + (i % 4), n_categories=2, seed=i % 7
+            )
+        specs.append((inst, f"tenant{i % 3}"))
+
+    svc = SelectionService(cfg)
+    chans = [
+        svc.submit(SelectionRequest(instance=inst, tenant=tenant))
+        for inst, tenant in specs
+    ]
+    results, rejections, errors, hangs = [], [], [], []
+    for i, ch in enumerate(chans):
+        try:
+            # the no-hang assertion: a terminal event MUST arrive within the
+            # deadline plus scheduling margin
+            results.append((i, ch.result(timeout=deadline_s + 120)))
+        except TimeoutError:
+            hangs.append(i)
+        except RuntimeError as exc:
+            if "DeadlineExceeded" in str(exc):
+                rejections.append((i, str(exc)[:200]))
+            else:
+                errors.append((i, str(exc)[:200]))
+    svc.shutdown()
+    if hangs:
+        failures.append(f"requests hung past their deadline: {hangs}")
+
+    # --- exactness: every completed request under the 1e-3 L∞ contract -----
+    worst_dev = 0.0
+    for i, res in results:
+        dev = float(res.audit.get("realization_dev", 0.0))
+        worst_dev = max(worst_dev, dev)
+        if not res.audit.get("contract_ok", True) or dev > 1e-3:
+            failures.append(
+                f"request {i} survived chaos but broke the contract "
+                f"(realization_dev={dev:.2e})"
+            )
+
+    # --- every injected fault class fired, and its recovery registered -----
+    fired = {}
+    counters = {}
+    for _i, res in results:
+        for site, n in res.audit.get("faults", {}).get("fired", {}).items():
+            fired[site] = fired.get(site, 0) + n
+        for name, n in res.audit.get("counters", {}).items():
+            if isinstance(n, (int, float)):
+                counters[name] = counters.get(name, 0) + n
+    bstats = svc.batcher.stats()
+
+    def recovered(*names) -> bool:
+        return any(counters.get(n, 0) > 0 for n in names)
+
+    recovery_of = {
+        "pdhg_nan": lambda: recovered(
+            "sentinel_quarantined", "sentinel_host_resolve", "robust_host_resolve"
+        ),
+        "worker_crash": lambda: recovered("robust_retry"),
+        "batcher_leader_death": lambda: (
+            recovered("robust_retry", "batcher_leader_reclaim")
+            or bstats.get("leader_reclaims", 0) > 0
+        ),
+        "queue_stall": lambda: (
+            len(results) + len(rejections) + len(errors) == n_requests
+        ),
+    }
+    mix_sites = [part.split(":")[0].strip() for part in fault_mix.split(",") if part]
+    for site in mix_sites:
+        if fired.get(site, 0) < 1:
+            failures.append(f"fault class '{site}' never fired under the mix")
+        elif not recovery_of.get(site, lambda: True)():
+            failures.append(
+                f"fault class '{site}' fired {fired[site]}x but no recovery "
+                "counter registered"
+            )
+
+    # --- offline solver-boundary chaos: the fault classes whose boundary
+    # the service-seeded fleet cannot reach (round-0 certification), each
+    # driven through its REAL entry point with the process-default injector
+    from citizensassemblies_tpu.robust.inject import FaultInjector, use_injector
+    from citizensassemblies_tpu.utils.logging import RunLog
+
+    offline = {}
+
+    def offline_pass(name, spec, seed, fn):
+        """Run one offline chaos exerciser under its own injector; a clean
+        twin must agree within the contract; fired/recovery evidence is
+        collected like the fleet's."""
+        olog = RunLog(echo=False)
+        inj = FaultInjector(spec, seed=seed)
+        try:
+            with use_injector(inj):
+                ok, note = fn(olog)
+        except Exception as exc:  # an unabsorbed fault IS a failure
+            ok, note = False, f"{type(exc).__name__}: {exc}"
+        stats = inj.stats()
+        offline[name] = {
+            "spec": spec,
+            "fired": stats["fired"],
+            "counters": {
+                k: v for k, v in sorted(olog.counters.items())
+                if k.startswith(("sentinel_", "robust_", "fault_"))
+            },
+            "ok": ok,
+            "note": note,
+        }
+        for site, n in stats["fired"].items():
+            fired[site] = fired.get(site, 0) + n
+        if not ok:
+            failures.append(f"offline chaos pass '{name}': {note}")
+        return olog, stats
+
+    # (a) face loop under oracle failures + mid-round kills, with
+    # checkpoints: weak seeds force multi-round CG so the anchor oracle
+    # actually prices; the aborted run must RESUME and still certify
+    def face_pass(olog):
+        from citizensassemblies_tpu.core.instance import featurize as _feat
+        from citizensassemblies_tpu.robust.inject import FaultInjected
+        from citizensassemblies_tpu.solvers.cg_typespace import (
+            CompositionOracle,
+            _leximin_relaxation,
+            _slice_relaxation,
+        )
+        from citizensassemblies_tpu.solvers.face_decompose import realize_profile
+        from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+        dense, _s = _feat(skewed_instance(n=120, k=12, n_categories=3, seed=1))
+        red = TypeReduction(dense)
+        v_relax, _x = _leximin_relaxation(red, RunLog(echo=False))
+        seeds = _slice_relaxation(
+            v_relax * red.msize.astype(np.float64), red, R=4
+        )
+        face_cfg = default_config().replace(
+            robust_checkpoint_every=1, robust_checkpoint_dir=ckpt_dir
+        )
+        eps = None
+        for _attempt in range(6):  # aborted runs resume from the checkpoint
+            try:
+                _C, _p, eps, _n = realize_profile(
+                    red, v_relax, list(seeds), CompositionOracle(red),
+                    accept=5e-4, log=olog, max_rounds=8, use_pdhg=False,
+                    cfg=face_cfg,
+                )
+                break
+            except FaultInjected:
+                continue
+        if eps is None:
+            return False, "face loop never completed within 6 resume attempts"
+        if eps > 8e-4:
+            return False, f"resumed face loop missed the band (eps {eps:.2e})"
+        if not olog.counters.get("fault_face_abort", 0):
+            return False, "face_abort never fired (pinned schedule drifted)"
+        if not olog.counters.get("robust_resume", 0):
+            return False, "face_abort fired but no checkpoint resume happened"
+        return True, f"eps {eps:.2e}"
+
+    # seed 8 pins: abort at round 1 of attempt 1 (after the round-0
+    # checkpoint) and again on attempt 2, so the resume path genuinely runs
+    offline_pass(
+        "face_oracle_abort", "oracle_raise:0.5,face_abort:0.3", 8, face_pass
+    )
+
+    # (b) warm-slot corruption on the batched engine's REAL reuse path: a
+    # repeat caller's second fleet loads (corrupted) slots — the lane
+    # sentinel must quarantine and the host re-solve must match the clean
+    # twin within the f32↔f64 band
+    def warm_pass(olog):
+        from citizensassemblies_tpu.solvers.batch_lp import (
+            final_primal_batch_lp,
+            solve_lp_batch,
+        )
+
+        rng = np.random.default_rng(5)
+        insts, probs = [], []
+        for _ in range(3):
+            P = (rng.random((16, 8)) < 0.5).astype(np.float64)
+            q = rng.random(16)
+            q /= q.sum()
+            target = P.T @ q
+            probs.append((P, target))
+            insts.append(final_primal_batch_lp(P, target))
+        wcfg = default_config().replace(lp_batch=True)
+        solve_lp_batch(  # warms the slots
+            insts, cfg=wcfg, log=olog, warm_key="chaos_warm",
+            max_iters=20_000, defer=False,
+        )
+        got = solve_lp_batch(  # loads (and corrupts) the slots
+            insts, cfg=wcfg, log=olog, warm_key="chaos_warm",
+            max_iters=20_000, defer=False,
+        )
+        if not all(np.all(np.isfinite(g.x)) for g in got):
+            return False, "corrupt warm slot leaked NaN through the fleet"
+        # every quarantined re-solve must still COVER its target (the ε-LP
+        # is one-sided — overshoot is free, SHORTFALL is the ε being
+        # minimized, and a feasible mixture with ε = 0 exists by
+        # construction); iterate equality is not the contract (the optimal
+        # face is non-unique)
+        worst = max(
+            float(np.maximum(target - P.T @ g.x[: P.shape[0]], 0.0).max())
+            for g, (P, target) in zip(got, probs)
+        )
+        if worst > 1e-3:
+            return False, f"quarantined re-solve shortfall {worst:.2e}"
+        return True, f"worst shortfall {worst:.2e}"
+
+    offline_pass("warm_slot", "warm_slot_corrupt:1.0", 13, warm_pass)
+
+    # (c) the fused L2 stage under a poisoned donor: the QP sentinel must
+    # quarantine and the serial float64-validated path must recover
+    def qp_pass(olog):
+        from citizensassemblies_tpu.service.context import (
+            RequestContext,
+            use_context,
+        )
+        from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
+
+        rng = np.random.default_rng(9)
+        P = (rng.random((24, 12)) < 0.4).astype(np.float64)
+        P[0] = 1.0  # no all-zero agents
+        q = rng.random(24)
+        q /= q.sum()
+        target = P.T @ q
+        # a slightly-off donor so the fused anchor actually runs (an exact
+        # donor's deviation is 0 and skips the device stage entirely)
+        donor = q + 0.02 * rng.random(24)
+        donor /= donor.sum()
+        qcfg = default_config().replace(lp_batch=True)
+        qctx = RequestContext.create(cfg=qcfg, log=olog)
+        with use_context(qctx):
+            p_out, eps_out = solve_final_primal_l2(
+                P, target, floor_donor=donor, cfg=qcfg, log=olog,
+                anchor_if_above=0.0,
+            )
+        alloc_dev = float(np.abs(P.T @ p_out - target).max())
+        if not np.all(np.isfinite(p_out)):
+            return False, "poisoned donor leaked NaN out of the L2 stage"
+        if alloc_dev > max(2.0 * eps_out, 1e-3):
+            return False, f"L2 allocation off its own eps ({alloc_dev:.2e})"
+        return True, f"alloc dev {alloc_dev:.2e} (eps {eps_out:.2e})"
+
+    offline_pass("qp_donor", "qp_nan:1.0", 17, qp_pass)
+
+    report = {
+        "chaos_ok": not failures,
+        "seconds": round(time.time() - t_start, 1),
+        "requests": n_requests,
+        "completed": len(results),
+        "deadline_rejections": len(rejections),
+        "failed": len(errors),
+        "hung": len(hangs),
+        "worst_realization_dev": round(worst_dev, 9),
+        "fault_mix": fault_mix,
+        "fault_seed": 7,
+        "fired": fired,
+        "recovery_counters": {
+            k: v
+            for k, v in sorted(counters.items())
+            if k.startswith(("sentinel_", "robust_", "fault_", "deadline_",
+                             "batcher_leader_"))
+        },
+        "batcher": bstats,
+        "offline": offline,
+        "errors": errors,
+        "failures": failures,
+    }
+    root_dir = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get(
+        "BENCH_CHAOS_REPORT", os.path.join(root_dir, "CHAOS_report.json")
+    )
+    try:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(report))
+    return 1 if failures else 0
+
+
 def trend() -> int:
     """``bench.py --trend``: the regression gate over the committed BENCH
     trajectory (``obs/trend.py``). Prints one JSON line (per-row deltas,
@@ -1321,6 +1666,8 @@ def trend() -> int:
 if __name__ == "__main__":
     if "--trend" in sys.argv:
         raise SystemExit(trend())
+    if "--chaos" in sys.argv:
+        raise SystemExit(chaos_bench(smoke_mode="--smoke" in sys.argv))
     if "--serve" in sys.argv:
         raise SystemExit(serve_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:

@@ -174,12 +174,13 @@ def iter_python_files(paths: Iterable[Path]) -> List[Path]:
 
 
 def all_rules():
-    """The registered rule set, R1..R8 (R0 is emitted by the engine itself)."""
+    """The registered rule set, R1..R9 (R0 is emitted by the engine itself)."""
     from citizensassemblies_tpu.lint.config_rule import ConfigKnobRule
     from citizensassemblies_tpu.lint.rules import (
         CoreSpanRule,
         DonatedBufferReuseRule,
         DtypeDisciplineRule,
+        FaultSiteRule,
         HostSyncInJitRule,
         JitConstructionRule,
         ThreadDisciplineRule,
@@ -195,6 +196,7 @@ def all_rules():
         ConfigKnobRule(),
         ThreadDisciplineRule(),
         CoreSpanRule(),
+        FaultSiteRule(),
     ]
 
 

@@ -1037,3 +1037,126 @@ class CoreSpanRule:
                     "(obs.hooks.dispatch_span)",
                 )
         return out
+
+
+class FaultSiteRule:
+    """R9 — every fault-injection site literal must be catalogued.
+
+    ``inject.site("<name>")`` / ``inject.raise_if("<name>")`` calls are the
+    hot-boundary consults of the graftfault registry
+    (``robust/inject.FAULT_SITES``). The rule enforces, per call site:
+
+    * the site name is a string LITERAL (a computed name cannot be audited
+      or reproduced from a chaos spec);
+    * the literal is registered in ``FAULT_SITES`` (parsed statically from
+      ``robust/inject.py`` when it is in the lint scope);
+    * the literal is documented in the README's fault-site catalogue (the
+      name must appear verbatim in backticks — the same README-as-contract
+      enforcement shape as R6's knob table and R8's span coverage).
+
+    A fault site that can fire in production chaos runs but is absent from
+    the operator-facing catalogue is exactly the undocumented blast radius
+    this rule exists to prevent.
+    """
+
+    rule_id = "R9"
+    name = "fault-site-catalogue"
+    description = "inject.site literals must be registered and README-documented"
+
+    _CALL_NAMES = ("site", "raise_if")
+
+    @staticmethod
+    def _registry_sites(modules: Sequence[ModuleSource]) -> Optional[Set[str]]:
+        """FAULT_SITES keys parsed from robust/inject.py, or None when the
+        registry module is outside the lint scope (README check still runs).
+        """
+        for mod in modules:
+            if mod.path.name != "inject.py" or "robust" not in str(mod.path):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.AnnAssign) and not isinstance(
+                    node, ast.Assign
+                ):
+                    continue
+                targets = (
+                    [node.target] if isinstance(node, ast.AnnAssign)
+                    else node.targets
+                )
+                named = any(
+                    isinstance(t, ast.Name) and t.id == "FAULT_SITES"
+                    for t in targets
+                )
+                if not named or not isinstance(node.value, ast.Dict):
+                    continue
+                return {
+                    k.value
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                }
+        return None
+
+    def check_package(
+        self, modules: Sequence[ModuleSource], readme=None
+    ) -> List[Violation]:
+        from citizensassemblies_tpu.lint.config_rule import _find_readme
+
+        registry = self._registry_sites(modules)
+        readme_path = _find_readme(modules, readme)
+        readme_text = (
+            readme_path.read_text(encoding="utf-8")
+            if readme_path is not None
+            else ""
+        )
+        out: List[Violation] = []
+        for mod in modules:
+            if mod.path.name == "inject.py" and "robust" in str(mod.path):
+                continue  # the registry itself
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                parts = d.rsplit(".", 2)
+                if parts[-1] not in self._CALL_NAMES:
+                    continue
+                # only the inject module's consults: inject.site(...) /
+                # inject.raise_if(...) (bare `site(...)` is too generic to
+                # claim — the repo convention imports the module)
+                if len(parts) < 2 or parts[-2] != "inject":
+                    continue
+
+                def flag(message: str) -> None:
+                    out.append(
+                        Violation(
+                            path=mod.rel, line=node.lineno, col=node.col_offset,
+                            rule=self.rule_id, name=self.name, message=message,
+                        )
+                    )
+
+                if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    flag(
+                        f"{parts[-1]}() needs a string LITERAL site name — a "
+                        "computed site cannot be audited against the "
+                        "catalogue or replayed from a chaos spec"
+                    )
+                    continue
+                site_name = node.args[0].value
+                if registry is not None and site_name not in registry:
+                    flag(
+                        f"fault site '{site_name}' is not registered in "
+                        "robust/inject.FAULT_SITES — register it (with a "
+                        "description) before consulting it"
+                    )
+                    continue
+                if readme_text and f"`{site_name}`" not in readme_text:
+                    flag(
+                        f"fault site '{site_name}' is missing from the "
+                        "README fault-injection catalogue — document the "
+                        "site (name in backticks) in the \"Fault tolerance "
+                        "& degradation\" section"
+                    )
+        return out

@@ -1,0 +1,52 @@
+"""graftfault: seeded fault injection + the production hardening it exercises.
+
+Three pieces, one contract:
+
+* :mod:`~citizensassemblies_tpu.robust.inject` — a config-gated,
+  seed-deterministic fault-injection registry. Hot boundaries consult named
+  sites (``inject.site("pdhg_nan", log)``); a chaos run with the same
+  ``Config.fault_sites`` spec and ``fault_seed`` fires the identical fault
+  schedule, so every chaos finding reproduces.
+* :mod:`~citizensassemblies_tpu.robust.policy` — per-request
+  :class:`~citizensassemblies_tpu.robust.policy.Deadline` (checked once per
+  CG round at the existing host sync point), exponential-backoff
+  :class:`~citizensassemblies_tpu.robust.policy.RetryBudget` for transient
+  faults, and the ordered
+  :class:`~citizensassemblies_tpu.robust.policy.DegradationLadder` (device
+  pricing → host MILP, ELL → dense, batched → serial, fused screen → host
+  screen) the service walks between retries.
+* :mod:`~citizensassemblies_tpu.robust.checkpoint` — crash-consistent face-
+  decomposition checkpoints: the CG loop snapshots its certified state every
+  N rounds so a killed request resumes from the last certified round.
+
+The contract that makes all of it safe: acceptance everywhere in this stack
+is the float64 *arithmetic* residual of whatever mixture comes back (the
+paper's 1e-3 L∞ audit), so a degraded, retried or resumed path is certified
+by the same check as the fast path — never "probably fine".
+"""
+
+from citizensassemblies_tpu.robust.inject import (
+    FAULT_SITES,
+    FaultInjected,
+    FaultInjector,
+    use_injector,
+)
+from citizensassemblies_tpu.robust.policy import (
+    DEGRADATION_LADDER,
+    Deadline,
+    DeadlineExceeded,
+    DegradationLadder,
+    RetryBudget,
+)
+
+__all__ = [
+    "FAULT_SITES",
+    "FaultInjected",
+    "FaultInjector",
+    "use_injector",
+    "DEGRADATION_LADDER",
+    "Deadline",
+    "DeadlineExceeded",
+    "DegradationLadder",
+    "RetryBudget",
+]

@@ -1,0 +1,162 @@
+"""Crash-consistent checkpointing of the face-decomposition CG loop.
+
+The PR 2 checkpoint layer (``utils/checkpoint``) snapshots the *outer*
+column-generation state at round boundaries; a killed request inside the
+face loop still restarted the whole decomposition. Here the face loop's own
+certified state — the portfolio columns, the current mixture and its
+arithmetic ε (the acceptance certificate is ``‖M p − v‖∞``, so the snapshot
+is certified by construction, not by trusting a solver) — is saved every N
+rounds (``Config.robust_checkpoint_every``) with the same atomic
+tmp-then-rename discipline, and :func:`load_face_state` resumes only into
+the identical (reduction, profile, acceptance bar) via a content
+fingerprint. A resumed run re-enters the round loop with the checkpointed
+hull and warm mixture: it converges to the same contract band as the
+uninterrupted run (pinned across seeds by ``tests/test_robust.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from pathlib import Path
+from typing import Optional, Union
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class FaceCGState:
+    """The face loop's certified state at a round boundary."""
+
+    compositions: np.ndarray  # int16/int32 [C, T]
+    probabilities: np.ndarray  # float64 [C] — the mixture p (certified)
+    eps: float  # its arithmetic residual ‖M p − v‖∞ at save time
+    round: int
+    fingerprint: str = ""
+
+
+def face_fingerprint(reduction, v: np.ndarray, accept: float) -> str:
+    """Digest of everything that pins the face problem: the type reduction's
+    structure (features, quotas, sizes, k), the target profile and the
+    acceptance bar. A checkpoint from any other problem must not resume."""
+    h = hashlib.sha256()
+    h.update(np.asarray(reduction.type_feature, dtype=np.int64).tobytes())
+    h.update(np.asarray(reduction.qmin, dtype=np.int64).tobytes())
+    h.update(np.asarray(reduction.qmax, dtype=np.int64).tobytes())
+    h.update(np.asarray(reduction.msize, dtype=np.int64).tobytes())
+    h.update(str(int(reduction.k)).encode())
+    h.update(np.asarray(v, dtype=np.float64).tobytes())
+    h.update(repr(float(accept)).encode())
+    return h.hexdigest()
+
+
+def save_face_state(path: Union[str, Path], state: FaceCGState) -> None:
+    """Atomic write (tmp + rename): a crash mid-save never corrupts the
+    previous checkpoint — the crash-consistency half of the contract."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(
+            fh,
+            kind=np.asarray([2], dtype=np.int8),  # face-state marker
+            compositions=state.compositions.astype(np.int32),
+            probabilities=state.probabilities.astype(np.float64),
+            eps=np.asarray([state.eps], dtype=np.float64),
+            round=np.asarray([state.round], dtype=np.int64),
+            fingerprint=np.frombuffer(state.fingerprint.encode(), dtype=np.uint8),
+        )
+    os.replace(tmp, path)
+
+
+def load_face_state(
+    path: Union[str, Path], T: int, fingerprint: str = ""
+) -> Optional[FaceCGState]:
+    """Load a face checkpoint if present and written for the same problem.
+    A mismatched or corrupt file is ignored (the caller starts fresh), never
+    an error — the checkpoint is an accelerant, not a dependency."""
+    path = Path(path)
+    if not path.exists():
+        return None
+    try:
+        with np.load(path) as z:
+            if "kind" not in z or int(z["kind"][0]) != 2:
+                return None
+            comps = z["compositions"]
+            if comps.ndim != 2 or comps.shape[1] != T:
+                return None
+            stored_fp = bytes(z["fingerprint"]).decode() if "fingerprint" in z else ""
+            if fingerprint and stored_fp != fingerprint:
+                return None
+            probs = z["probabilities"]
+            if probs.shape[0] != comps.shape[0]:
+                return None
+            return FaceCGState(
+                compositions=comps.astype(np.int32),
+                probabilities=probs.astype(np.float64),
+                eps=float(z["eps"][0]),
+                round=int(z["round"][0]),
+                fingerprint=stored_fp,
+            )
+    except Exception:
+        return None
+
+
+def clear_face_state(path: Union[str, Path]) -> None:
+    Path(path).unlink(missing_ok=True)
+
+
+class FaceCheckpointer:
+    """The face loop's checkpoint driver: resolves the path from the config
+    (``robust_checkpoint_dir`` / ``face_<fp16>.npz``), loads a matching
+    snapshot on entry, saves the running-best certified state every
+    ``robust_checkpoint_every`` rounds, and clears the file once the loop
+    returns a certified result (a completed run must not leave a stale
+    resume point for the next request of the same problem)."""
+
+    def __init__(self, cfg, reduction, v: np.ndarray, accept: float):
+        self.every = int(getattr(cfg, "robust_checkpoint_every", 0) or 0)
+        ckpt_dir = str(getattr(cfg, "robust_checkpoint_dir", "") or "")
+        self.enabled = self.every > 0 and bool(ckpt_dir)
+        self.path: Optional[Path] = None
+        self.fingerprint = ""
+        self._last_saved_round = -1
+        if not self.enabled:
+            return
+        self.fingerprint = face_fingerprint(reduction, v, accept)
+        self.path = Path(ckpt_dir) / f"face_{self.fingerprint[:16]}.npz"
+
+    def load(self, T: int) -> Optional[FaceCGState]:
+        if not self.enabled:
+            return None
+        return load_face_state(self.path, T, self.fingerprint)
+
+    def maybe_save(
+        self, rnd: int, comps: np.ndarray, p: np.ndarray, eps: float, log=None
+    ) -> bool:
+        """Save at round boundaries (every N rounds, once per round). The
+        state handed in is the loop's running best — already certified by
+        its arithmetic residual."""
+        if not self.enabled or rnd == self._last_saved_round:
+            return False
+        if rnd % self.every != 0:
+            return False
+        self._last_saved_round = rnd
+        save_face_state(
+            self.path,
+            FaceCGState(
+                compositions=np.asarray(comps),
+                probabilities=np.asarray(p, dtype=np.float64),
+                eps=float(eps),
+                round=int(rnd),
+                fingerprint=self.fingerprint,
+            ),
+        )
+        if log is not None:
+            log.count("robust_checkpoint_saved")
+        return True
+
+    def clear(self) -> None:
+        if self.enabled and self.path is not None:
+            clear_face_state(self.path)

@@ -1,0 +1,207 @@
+"""Seeded, config-gated fault injection for chaos runs.
+
+Every fault site is a named knob consulted at a hot boundary::
+
+    from citizensassemblies_tpu.robust import inject
+    if inject.site("pdhg_nan", log):
+        x0[0] = np.nan  # poison the lane; the sentinel must quarantine it
+
+Sites are registered in :data:`FAULT_SITES` (graftlint R9 additionally
+requires every ``inject.site(...)`` literal to be documented in the README
+catalogue, the same enforcement shape as R8's span coverage). A chaos run is
+configured by ``Config.fault_sites`` — a spec string
+``"pdhg_nan:0.1,oracle_raise:0.05"`` of per-site firing rates — plus
+``Config.fault_seed``. Firing decisions are **deterministic**: the n-th
+consultation of a site fires iff ``crc(seed, site, n)`` maps below the rate,
+so the same spec + seed reproduces the identical fault schedule across
+processes and machines (no process-salted ``hash``, no global RNG state).
+
+The injector is ambient: the service installs one per request on its
+``RequestContext``; offline harnesses (``bench.py --chaos``, tests) install
+a process default via :func:`use_injector`. With no injector installed —
+the production default, ``fault_sites=""`` — :func:`site` is a dict lookup
+and a ``None`` check: zero allocation, no RNG, nothing to misfire.
+
+Nothing here imports jax; the module must stay importable from the lint
+tooling.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+#: the registry: site name → where it fires and what recovery it exercises.
+#: graftlint R9 checks every ``inject.site("<name>")`` literal against the
+#: README "Fault injection sites" catalogue.
+FAULT_SITES: Dict[str, str] = {
+    "pdhg_nan": (
+        "poisons a PDHG warm start with NaN (serial wrapper or one batched "
+        "lane) — exercises the in-loop numerical sentinel + float64 host "
+        "re-solve quarantine"
+    ),
+    "qp_nan": (
+        "poisons the fused L2 stage's donor iterate — exercises the QP "
+        "sentinel and the serial float64 fallback of solve_final_primal_l2"
+    ),
+    "oracle_raise": (
+        "anchor-oracle backend (native/HiGHS) failure — exercises the "
+        "retry-once-then-skip policy (anchors are heuristic columns)"
+    ),
+    "device_dispatch": (
+        "device-pricing dispatch raises — exercises the device→host-MILP "
+        "rung of the degradation ladder"
+    ),
+    "batcher_leader_death": (
+        "cross-request batcher leader dies after claiming a group, before "
+        "dispatch — exercises the follower watchdog / re-election"
+    ),
+    "warm_slot_corrupt": (
+        "a loaded warm-start slot is NaN-corrupted — exercises lane "
+        "quarantine (a corrupt warm start must not poison the fleet)"
+    ),
+    "worker_crash": (
+        "the request worker crashes at execution start — exercises the "
+        "service retry budget + degradation ladder"
+    ),
+    "queue_stall": (
+        "artificial pre-execution stall — exercises deadline accounting "
+        "and graceful DeadlineExceeded rejection"
+    ),
+    "face_abort": (
+        "kills the face-decomposition loop mid-round — exercises the "
+        "crash-consistent checkpoint/resume path"
+    ),
+}
+
+
+class FaultInjected(RuntimeError):
+    """A deliberately injected, *transient* fault. The service retry policy
+    treats it (and real transient backend errors) as retryable."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at site '{site}'")
+        self.site = site
+
+
+def _hash_unit(seed: int, site: str, n: int) -> float:
+    """Deterministic uniform value in [0, 1) for consultation ``n`` of
+    ``site`` under ``seed`` — blake2b, not ``hash()`` (salted per process)
+    and not crc32 (linear: consecutive consults would differ by a FIXED
+    xor, correlating the schedule and making some joint fire patterns
+    impossible)."""
+    digest = hashlib.blake2b(
+        f"{seed}:{site}:{n}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big") / 18446744073709551616.0
+
+
+class FaultInjector:
+    """Parsed ``fault_sites`` spec + per-site consultation counters.
+
+    Thread-safe: several worker threads of one request (anchor pricer,
+    batcher leader) consult sites concurrently; the counter increment is the
+    only shared state and rides one lock.
+    """
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.seed = int(seed)
+        self.spec = spec or ""
+        self._rates: Dict[str, float] = {}
+        for part in self.spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, rate = part.partition(":")
+            name = name.strip()
+            if name not in FAULT_SITES:
+                raise ValueError(
+                    f"unknown fault site {name!r} (known: {sorted(FAULT_SITES)})"
+                )
+            self._rates[name] = min(max(float(rate or 1.0), 0.0), 1.0)
+        self._lock = threading.Lock()
+        self._consulted: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+
+    def fire(self, site: str) -> bool:
+        """Deterministically decide whether this consultation of ``site``
+        fires. Unknown sites are a programming error (R9 keeps the literals
+        honest; this keeps the runtime honest)."""
+        if site not in FAULT_SITES:
+            raise ValueError(f"unknown fault site {site!r}")
+        rate = self._rates.get(site)
+        if rate is None or rate <= 0.0:
+            return False
+        with self._lock:
+            n = self._consulted.get(site, 0)
+            self._consulted[site] = n + 1
+            hit = _hash_unit(self.seed, site, n) < rate
+            if hit:
+                self._fired[site] = self._fired.get(site, 0) + 1
+        return hit
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                "consulted": dict(self._consulted),
+                "fired": dict(self._fired),
+            }
+
+
+#: process-default injector for offline harnesses (bench --chaos, tests);
+#: requests under a RequestContext carry their own and never read this
+_DEFAULT: Optional[FaultInjector] = None
+
+
+def install_injector(inj: Optional[FaultInjector]) -> None:
+    global _DEFAULT
+    _DEFAULT = inj
+
+
+@contextmanager
+def use_injector(inj: Optional[FaultInjector]):
+    """Install ``inj`` as the process-default injector for the scope."""
+    global _DEFAULT
+    prev, _DEFAULT = _DEFAULT, inj
+    try:
+        yield inj
+    finally:
+        _DEFAULT = prev
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The calling thread's injector: the ambient RequestContext's when one
+    is active, else the process default (offline chaos harness), else None
+    (production: injection compiled out to a None check)."""
+    from citizensassemblies_tpu.service.context import current_context
+
+    ctx = current_context()
+    if ctx is not None and getattr(ctx, "injector", None) is not None:
+        return ctx.injector
+    return _DEFAULT
+
+
+def site(name: str, log=None, inj: Optional[FaultInjector] = None) -> bool:
+    """Consult fault site ``name``; counts ``fault_<name>`` on ``log`` when
+    it fires. The call sites pass a string LITERAL (graftlint R9). ``inj``
+    overrides the ambient lookup — worker threads that outlive their
+    request's ContextVar scope (the anchor pricer) capture the injector at
+    construction and pass it explicitly."""
+    if inj is None:
+        inj = active_injector()
+    if inj is None:
+        return False
+    if inj.fire(name):
+        if log is not None:
+            log.count(f"fault_{name}")
+        return True
+    return False
+
+
+def raise_if(name: str, log=None, inj: Optional[FaultInjector] = None) -> None:
+    """Consult ``name`` and raise :class:`FaultInjected` when it fires —
+    for sites whose real-world analog is an exception, not a corruption."""
+    if site(name, log, inj=inj):
+        raise FaultInjected(name)

@@ -1,0 +1,140 @@
+"""Deadline, retry and degradation policy for the serving stack.
+
+Restarted first-order solvers need divergence/stall detection and principled
+restarts to be dependable (Applegate et al., PDLP); a *service* over them
+additionally needs bounded wall-clock and a principled answer to "what do we
+turn off when a fast path keeps failing". This module is that answer:
+
+* :class:`Deadline` — a per-request monotonic budget threaded through
+  ``RequestContext``. The CG round loop checks it ONCE per round at the
+  round's existing single host sync point (a host clock read — no new
+  host↔device syncs), so a request can never grind past its deadline inside
+  the face loop; expiry raises :class:`DeadlineExceeded` carrying a partial
+  audit fragment instead of hanging.
+* :class:`RetryBudget` — counted exponential-backoff retries for transient
+  faults (injected or real backend failures). The budget is per request;
+  exhaustion re-raises the fault.
+* :class:`DegradationLadder` — the ORDERED fallback chain walked one rung
+  per retry: device pricing → host MILP, ELL → dense, batched → serial,
+  fused screen → host screen. Every rung lands on a gate whose off-position
+  is pinned bit-identical by the existing test suite, so a degraded request
+  is *slower, not different* — and its result still passes the same 1e-3
+  L∞ arithmetic audit.
+
+Nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from citizensassemblies_tpu.utils.config import Config
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired. ``partial`` carries whatever audit
+    fragment the raising layer could assemble (best-so-far ε, round count),
+    so the graceful rejection ships evidence instead of a bare timeout."""
+
+    def __init__(self, message: str, partial: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.partial = partial or {}
+
+
+class Deadline:
+    """Monotonic per-request wall-clock budget."""
+
+    def __init__(self, seconds: float):
+        self.seconds = float(seconds)
+        self.t0 = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.t0
+
+    def remaining(self) -> float:
+        return self.seconds - self.elapsed()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(
+        self, where: str, log=None, partial: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Raise :class:`DeadlineExceeded` when expired; counts the trip on
+        ``log``. A pure host clock read — safe at any frequency, and the CG
+        loop calls it once per round so no new host syncs appear."""
+        if not self.expired:
+            return
+        if log is not None:
+            log.count("deadline_exceeded")
+        raise DeadlineExceeded(
+            f"deadline of {self.seconds:.1f}s exceeded at {where} "
+            f"({self.elapsed():.1f}s elapsed)",
+            partial=partial,
+        )
+
+
+class RetryBudget:
+    """Counted exponential-backoff retries for transient faults."""
+
+    def __init__(self, attempts: int = 2, backoff_s: float = 0.05):
+        self.attempts = max(int(attempts), 0)
+        self.backoff_s = max(float(backoff_s), 0.0)
+        self.used = 0
+
+    @property
+    def left(self) -> int:
+        return self.attempts - self.used
+
+    def take(self) -> Optional[float]:
+        """Consume one retry; returns the backoff delay (exponential in the
+        retries already used) or None when the budget is exhausted."""
+        if self.used >= self.attempts:
+            return None
+        delay = self.backoff_s * (2.0 ** self.used)
+        self.used += 1
+        return delay
+
+
+#: the certified fallback chain, in order: each rung is a Config gate whose
+#: off-position runs a pinned bit-identical (or certified-equivalent) path
+DEGRADATION_LADDER: Tuple[Tuple[str, Dict[str, Any]], ...] = (
+    ("device_pricing_host_milp", {"decomp_device_pricing": False}),
+    ("ell_to_dense", {"sparse_ops": False}),
+    ("batched_to_serial", {"lp_batch": False}),
+    ("fused_screen_to_host", {"decomp_batched_expand": False}),
+)
+
+
+class DegradationLadder:
+    """Walk the certified fallback chain one rung per transient fault.
+
+    Each :meth:`degrade` call returns a Config with the next rung's gate
+    forced off (cumulatively — rung 2 keeps rung 1's downgrade). Past the
+    last rung the config is returned unchanged: the bottom of the ladder is
+    the all-serial all-host path, which either works or the fault is not
+    something a fallback fixes.
+    """
+
+    def __init__(self):
+        self.steps: List[str] = []
+
+    @property
+    def position(self) -> int:
+        return len(self.steps)
+
+    @property
+    def exhausted(self) -> bool:
+        return self.position >= len(DEGRADATION_LADDER)
+
+    def degrade(self, cfg: Config, log=None) -> Config:
+        if self.exhausted:
+            return cfg
+        name, patch = DEGRADATION_LADDER[self.position]
+        self.steps.append(name)
+        if log is not None:
+            log.count(f"robust_degrade_{name}")
+            log.count("robust_degrade_steps")
+        return cfg.replace(**patch)

@@ -42,11 +42,17 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from citizensassemblies_tpu.robust import inject
 from citizensassemblies_tpu.utils.config import Config, default_config
 
-#: follower safety net: if the leader vanished (worker killed mid-dispatch),
-#: a follower re-claims its fleet after this long and solves directly
+#: follower safety net of last resort: past this, a follower re-claims its
+#: own fleet and solves solo even if leadership state looks healthy
 _FOLLOWER_TIMEOUT_S = 120.0
+
+#: floor on the follower watchdog's poll interval — each wake checks the
+#: leader's liveness (thread dead / claim released), so a dead leader is
+#: detected within ~2 window widths instead of the 120 s safety net
+_WATCHDOG_POLL_S = 0.05
 
 
 class _Pending:
@@ -73,6 +79,10 @@ class CrossRequestBatcher:
         self._lock = threading.Lock()
         self._groups: Dict[tuple, List[_Pending]] = {}
         self._leaders: Set[tuple] = set()
+        #: the leader's THREAD per claimed group — the followers' heartbeat:
+        #: a claim whose thread is no longer alive is a dead leader, and the
+        #: first follower to notice re-elects itself and dispatches
+        self._leader_threads: Dict[tuple, threading.Thread] = {}
         # --- occupancy accounting (read by the bench's BENCH row) ----------
         self._stats = {
             "submissions": 0,          # solve_lp_batch calls deferred here
@@ -80,6 +90,8 @@ class CrossRequestBatcher:
             "fused_dispatches": 0,     # … that merged ≥2 distinct requests
             "solves": 0,               # real LP instances solved
             "max_requests_fused": 0,   # largest request count in one merge
+            "leader_deaths": 0,        # leaders that died before dispatch
+            "leader_reclaims": 0,      # follower re-elections after a death
         }
 
     # --- public API ---------------------------------------------------------
@@ -118,16 +130,75 @@ class CrossRequestBatcher:
             lead = key not in self._leaders
             if lead:
                 self._leaders.add(key)
+                self._leader_threads[key] = threading.current_thread()
         if lead:
-            if self.window_s > 0:
-                time.sleep(self.window_s)  # GIL released; followers join
-            with self._lock:
-                batch = self._groups.pop(key, [])
-                self._leaders.discard(key)
-            self._dispatch(key, batch, cfg)
+            dispatched = False
+            try:
+                if self.window_s > 0:
+                    time.sleep(self.window_s)  # GIL released; followers join
+                # chaos: the leader "dies" after claiming the group, before
+                # dispatch — the exact hang the follower watchdog exists for
+                inject.raise_if("batcher_leader_death", log)
+                with self._lock:
+                    batch = self._groups.pop(key, [])
+                    self._leaders.discard(key)
+                    self._leader_threads.pop(key, None)
+                dispatched = True
+                self._dispatch(key, batch, cfg)
+            finally:
+                if not dispatched:
+                    # the leader is dying between claim and dispatch (an
+                    # exception here; a hard thread kill skips this and is
+                    # caught by the is_alive() heartbeat instead): release
+                    # the claim so the watchdog re-elects promptly
+                    with self._lock:
+                        self._leaders.discard(key)
+                        self._leader_threads.pop(key, None)
+                        self._stats["leader_deaths"] += 1
         else:
-            if not pend.event.wait(timeout=_FOLLOWER_TIMEOUT_S):
-                # leader died without dispatching us: re-claim and solve solo
+            self._follower_wait(key, pend, cfg)
+        if pend.error is not None:
+            raise pend.error
+        return pend.results
+
+    def _follower_wait(self, key: tuple, pend: _Pending, cfg: Config) -> None:
+        """Wait for the leader's dispatch under the liveness watchdog.
+
+        Every poll interval the follower checks the group's leadership: a
+        claim that was released without a dispatch, or whose leader THREAD
+        is no longer alive, is a dead leader — the first follower to see it
+        re-elects itself and dispatches the whole remaining group (so its
+        group-mates are rescued too, not just its own fleet). The old
+        120 s full-window wait is kept only as the safety net of last
+        resort."""
+        waited = 0.0
+        poll = max(self.window_s * 2.0, _WATCHDOG_POLL_S)
+        while not pend.event.wait(timeout=poll):
+            waited += poll
+            with self._lock:
+                in_group = any(p is pend for p in self._groups.get(key, []))
+                lt = self._leader_threads.get(key)
+                leader_dead = in_group and (
+                    key not in self._leaders
+                    or (lt is not None and not lt.is_alive())
+                )
+                if leader_dead:
+                    # re-elect: claim the group before releasing the lock so
+                    # exactly one follower becomes the new leader
+                    self._leaders.add(key)
+                    self._leader_threads[key] = threading.current_thread()
+                    self._stats["leader_reclaims"] += 1
+            if leader_dead:
+                if pend.log is not None:
+                    pend.log.count("batcher_leader_reclaim")
+                with self._lock:
+                    batch = self._groups.pop(key, [])
+                    self._leaders.discard(key)
+                    self._leader_threads.pop(key, None)
+                self._dispatch(key, batch, cfg)
+                return
+            if waited >= _FOLLOWER_TIMEOUT_S:
+                # last-resort: re-claim only our own fleet and solve solo
                 with self._lock:
                     group = self._groups.get(key, [])
                     mine = pend in group
@@ -137,9 +208,7 @@ class CrossRequestBatcher:
                     self._dispatch(key, [pend], cfg)
                 else:
                     pend.event.wait()  # dispatch in flight — finish it
-        if pend.error is not None:
-            raise pend.error
-        return pend.results
+                return
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

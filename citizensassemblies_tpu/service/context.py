@@ -36,6 +36,8 @@ from citizensassemblies_tpu.utils.logging import RunLog
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from citizensassemblies_tpu.obs.trace import Tracer
+    from citizensassemblies_tpu.robust.inject import FaultInjector
+    from citizensassemblies_tpu.robust.policy import Deadline, RetryBudget
     from citizensassemblies_tpu.service.batcher import CrossRequestBatcher
     from citizensassemblies_tpu.service.session import TenantSession
     from citizensassemblies_tpu.solvers.batch_lp import WarmSlotStore
@@ -87,6 +89,33 @@ class RequestContext:
     #: concurrent requests produce disjoint, well-nested span trees — the
     #: trace-isolation contract ``tests/test_obs.py`` pins
     tracer: Optional["Tracer"] = None
+    # --- graftfault (citizensassemblies_tpu/robust) -------------------------
+    #: per-request wall-clock deadline (``Config.serve_deadline_s``): the CG
+    #: round loop checks it once per round at the existing sync point and
+    #: raises a graceful ``DeadlineExceeded`` past it
+    deadline: Optional["Deadline"] = None
+    #: per-request transient-fault retry budget (exponential backoff); the
+    #: service walks the degradation ladder one rung per retry
+    retry: Optional["RetryBudget"] = None
+    #: per-request fault injector (``Config.fault_sites``) — chaos runs
+    #: only; None in production (the hot-boundary consults short-circuit)
+    injector: Optional["FaultInjector"] = None
+
+    def teardown(self, success: bool) -> None:
+        """Request-scoped state cleanup, called on EVERY exit path.
+
+        On a non-success exit the request's warm slots and any session
+        packs it wrote are rolled back — an aborted request must not leave
+        half-written warm state for its tenant's next request to trip over
+        (a failed solve's iterates are exactly the ones not to reuse).
+        Success leaves the session state in place (that reuse is the
+        session's point)."""
+        if success:
+            return
+        if self.warm_store is not None:
+            self.warm_store.clear()
+        if self.session is not None:
+            self.session.rollback_request(self.request_id)
 
     @classmethod
     def create(

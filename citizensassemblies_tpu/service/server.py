@@ -99,18 +99,30 @@ class ResultChannel:
     """Streamed events of one request: ``("progress", line)`` while the job
     runs, then exactly one terminal ``("result", RequestResult)`` or
     ``("error", message)``. Events are retained, so :meth:`events` and
-    :meth:`result` may be called in any order (or repeatedly)."""
+    :meth:`result` may be called in any order (or repeatedly).
+
+    Retention is CAPPED (``Config.serve_channel_cap``): a long request's
+    progress + metrics stream cannot grow without bound — past the cap,
+    incoming non-terminal events are dropped and counted
+    (:attr:`dropped`); the terminal result + audit stamp is always
+    retained."""
 
     _TERMINAL = ("result", "error")
 
-    def __init__(self, request_id: str):
+    def __init__(self, request_id: str, cap: int = 1024):
         self.request_id = request_id
         self._cond = threading.Condition()
         self._events: List[Tuple[str, Any]] = []
         self._done = False
+        self._cap = max(int(cap), 8)
+        #: non-terminal events dropped by the retention cap
+        self.dropped = 0
 
     def push(self, kind: str, payload: Any) -> None:
         with self._cond:
+            if kind not in self._TERMINAL and len(self._events) >= self._cap:
+                self.dropped += 1
+                return
             self._events.append((kind, payload))
             if kind in self._TERMINAL:
                 self._done = True
@@ -202,12 +214,23 @@ class SelectionService:
         self._traces: List[Any] = []
         self._snap_stop = threading.Event()
         self._snap_thread: Optional[threading.Thread] = None
+        #: drain bookkeeping: rid → (future, channel). Shutdown cancels the
+        #: queued-but-unstarted futures and pushes each a typed terminal
+        #: rejection; running requests complete (or are deadline-bounded)
+        self._futures: Dict[str, Tuple[Any, ResultChannel]] = {}
+        self._closed = False
 
     # --- public API ---------------------------------------------------------
 
     def submit(self, request: SelectionRequest) -> ResultChannel:
         """Admit one request; returns its streaming channel immediately."""
         with self._lock:
+            if self._closed:
+                self.metrics.counter(
+                    "graftserve_admission_rejected_total",
+                    help="submissions refused by back-pressure",
+                ).inc()
+                raise AdmissionError("service is shut down")
             if self._in_flight >= self.queue_depth:
                 self.metrics.counter(
                     "graftserve_admission_rejected_total",
@@ -219,11 +242,16 @@ class SelectionService:
                 )
             self._in_flight += 1
         rid = request.request_id or _next_request_id()
-        channel = ResultChannel(rid)
+        cfg = request.cfg or self.cfg
+        channel = ResultChannel(
+            rid, cap=int(getattr(cfg, "serve_channel_cap", 1024) or 1024)
+        )
         with self._lock:
             self._channels[rid] = channel
         self._ensure_snapshot_loop()
-        self._pool.submit(self._run_request, request, rid, channel)
+        fut = self._pool.submit(self._run_request, request, rid, channel)
+        with self._lock:
+            self._futures[rid] = (fut, channel)
         return channel
 
     def run(self, request: SelectionRequest, timeout: Optional[float] = None):
@@ -329,8 +357,44 @@ class SelectionService:
         return export_chrome_trace(tracers, path=path)
 
     def shutdown(self, wait: bool = True) -> None:
+        """Drain semantics: in-flight requests COMPLETE (their channels get
+        a normal terminal event), queued-but-unstarted requests get a typed
+        ``ServiceShutdown`` rejection, new submissions raise
+        ``AdmissionError``, and the snapshot thread is joined — no service
+        thread outlives the call (``tests/test_robust.py`` asserts via
+        thread enumeration)."""
+        with self._lock:
+            self._closed = True
         self._snap_stop.set()
-        self._pool.shutdown(wait=wait)
+        # cancel_futures rejects the queued tail; wait=True drains the
+        # running requests to their terminal events first
+        self._pool.shutdown(wait=wait, cancel_futures=True)
+        with self._lock:
+            cancelled = [
+                (rid, ch)
+                for rid, (fut, ch) in self._futures.items()
+                if fut is not None and fut.cancelled()
+            ]
+            self._futures.clear()
+        for rid, ch in cancelled:
+            with self._lock:
+                self._failed += 1
+                self._in_flight -= 1
+                self._channels.pop(rid, None)
+            self.metrics.counter(
+                "graftserve_shutdown_rejected_total",
+                help="queued requests rejected by shutdown drain",
+            ).inc()
+            ch.push(
+                "error",
+                {
+                    "kind": "ServiceShutdown",
+                    "message": f"request {rid} cancelled before start: "
+                    "service shut down",
+                },
+            )
+        if self._snap_thread is not None:
+            self._snap_thread.join(timeout=5.0)
 
     def __enter__(self) -> "SelectionService":
         return self
@@ -350,38 +414,71 @@ class SelectionService:
     def _run_request(
         self, request: SelectionRequest, rid: str, channel: ResultChannel
     ) -> None:
+        from citizensassemblies_tpu.robust.inject import (
+            FaultInjected,
+            FaultInjector,
+        )
+        from citizensassemblies_tpu.robust.policy import (
+            Deadline,
+            DeadlineExceeded,
+            DegradationLadder,
+            RetryBudget,
+        )
         from citizensassemblies_tpu.utils.guards import CompilationGuard
 
         t0 = time.monotonic()
+        base_cfg = request.cfg or self.cfg
+        log = _ChannelLog(channel)
+        # --- graftfault per-request machinery (robust/) --------------------
+        injector = None
+        if getattr(base_cfg, "fault_sites", ""):
+            import zlib
+
+            # per-request schedule: derive from the request id so the fleet
+            # doesn't fire identical faults in lockstep — still fully
+            # deterministic given fault_seed + submission order
+            injector = FaultInjector(
+                base_cfg.fault_sites,
+                seed=int(getattr(base_cfg, "fault_seed", 0))
+                + zlib.crc32(rid.encode()),
+            )
+        dl_s = float(getattr(base_cfg, "serve_deadline_s", 0.0) or 0.0)
+        deadline = Deadline(dl_s) if dl_s > 0 else None
+        retry = RetryBudget(
+            int(getattr(base_cfg, "serve_retry_max", 2)),
+            float(getattr(base_cfg, "serve_retry_backoff_s", 0.05)),
+        )
+        ladder = DegradationLadder()
+        cfg = base_cfg
+        ctx: Optional[RequestContext] = None
+        success = False
         try:
-            cfg = request.cfg or self.cfg
-            log = _ChannelLog(channel)
+            if injector is not None and injector.fire("queue_stall"):
+                # chaos: artificial stall before execution — the deadline
+                # accounting (and graceful rejection) must absorb it
+                log.count("fault_queue_stall")
+                time.sleep(0.25 if dl_s <= 0 else min(0.25, dl_s))
             # per-request tracing: obs_trace=True is the opt-in sampling
             # mode — every request gets its OWN Tracer (disjoint traces by
             # construction), installed ambiently by use_context below and
             # carried on the log so worker threads (anchor pricer, batcher
             # leader) attribute to the owning request
             tracer = None
-            if getattr(cfg, "obs_trace", None) is True:
+            if getattr(base_cfg, "obs_trace", None) is True:
                 from citizensassemblies_tpu.obs.trace import Tracer
 
                 tracer = Tracer(name=rid, sample_device=True)
                 log.tracer = tracer
             session = self.tenants.session(request.tenant)
-            ctx = RequestContext(
-                cfg=cfg,
-                log=log,
-                request_id=rid,
-                tenant=request.tenant,
-                warm_store=session.warm_store_for(rid),
-                session=session,
-                batcher=self.batcher,
-                tracer=tracer,
-            )
             dense, space = self._featurize(request)
-            fp = self._fingerprint(request, dense, cfg)
+            fp = self._fingerprint(request, dense, base_cfg)
             memo_hit = session.memo_get((request.algorithm, fp))
             if memo_hit is not None:
+                ctx = self._build_context(
+                    request, rid, cfg, log, session, tracer, deadline, retry,
+                    injector,
+                )
+                success = True
                 with self._lock:
                     self._memo_served += 1
                     self._completed += 1
@@ -395,17 +492,56 @@ class SelectionService:
                     ),
                 )
                 return
-            with use_context(ctx):
-                with CompilationGuard(name=f"serve_{rid}", log=log) as guard:
-                    if tracer is not None:
-                        with tracer.span(
-                            "request", algorithm=request.algorithm,
-                            tenant=request.tenant,
-                        ):
-                            result = self._execute(request, dense, space, ctx, fp)
-                    else:
-                        result = self._execute(request, dense, space, ctx, fp)
+            # --- transient-fault retry loop (robust/policy) ----------------
+            # each retry backs off exponentially and walks ONE rung down the
+            # certified degradation ladder; the deadline bounds the whole
+            # loop (a retry that cannot fit its backoff rejects gracefully)
+            while True:
+                ctx = self._build_context(
+                    request, rid, cfg, log, session, tracer, deadline, retry,
+                    injector,
+                )
+                try:
+                    if deadline is not None:
+                        deadline.check("request start", log=log)
+                    with use_context(ctx):
+                        with CompilationGuard(name=f"serve_{rid}", log=log) as guard:
+                            if tracer is not None:
+                                with tracer.span(
+                                    "request", algorithm=request.algorithm,
+                                    tenant=request.tenant,
+                                ):
+                                    result = self._execute(
+                                        request, dense, space, ctx, fp
+                                    )
+                            else:
+                                result = self._execute(request, dense, space, ctx, fp)
+                    break
+                except FaultInjected as exc:
+                    delay = retry.take()
+                    if delay is None:
+                        raise  # budget exhausted: the fault is the outcome
+                    # roll back the failed attempt's request-scoped writes
+                    # before retrying (half-written warm state must not
+                    # seed the retry), then degrade one rung
+                    ctx.teardown(success=False)
+                    log.count("robust_retry")
+                    cfg = ladder.degrade(cfg, log)
+                    log.emit(
+                        f"request {rid}: transient fault "
+                        f"({exc.site}); retry {retry.used}/{retry.attempts} "
+                        f"after {delay * 1000:.0f}ms"
+                        + (
+                            f", degraded to {ladder.steps[-1]}"
+                            if ladder.steps else ""
+                        )
+                    )
+                    if deadline is not None and deadline.remaining() <= delay:
+                        deadline.check("retry backoff", log=log)
+                    time.sleep(delay)
             session.memo_put((request.algorithm, fp), result)
+            session.finish_request(rid)
+            success = True
             payload = self._finish(
                 request, rid, result, t0, ctx, compiles=guard.count
             )
@@ -426,6 +562,36 @@ class SelectionService:
                 self._completed += 1
                 self._in_flight -= 1
             channel.push("result", payload)
+        except DeadlineExceeded as exc:
+            # graceful rejection: a typed terminal event carrying a PARTIAL
+            # audit stamp (elapsed, counters, best-so-far evidence from the
+            # raising layer) instead of a hang or a bare timeout
+            self.metrics.counter(
+                "graftserve_deadline_total",
+                help="requests rejected by their deadline, per tenant",
+                labelnames=("tenant",),
+            ).labels(tenant=request.tenant).inc()
+            with self._lock:
+                self._failed += 1
+                self._in_flight -= 1
+            channel.push(
+                "error",
+                {
+                    "kind": "DeadlineExceeded",
+                    "message": str(exc),
+                    "audit": {
+                        "request_id": rid,
+                        "tenant": request.tenant,
+                        "algorithm": request.algorithm,
+                        "deadline_s": dl_s,
+                        "elapsed_s": round(time.monotonic() - t0, 3),
+                        "degrade_steps": list(ladder.steps),
+                        "retries_used": retry.used,
+                        "counters": log.counters,
+                        **exc.partial,
+                    },
+                },
+            )
         except BaseException as exc:
             self.metrics.counter(
                 "graftserve_failed_total", help="failed requests per tenant",
@@ -436,8 +602,32 @@ class SelectionService:
                 self._in_flight -= 1
             channel.push("error", f"{type(exc).__name__}: {exc}")
         finally:
+            if ctx is not None:
+                # non-success exits roll back the request's warm slots and
+                # session pack writes (satellite: no half-written tenant
+                # state on any failure path)
+                ctx.teardown(success=success)
             with self._lock:
                 self._channels.pop(rid, None)
+                self._futures.pop(rid, None)
+
+    def _build_context(
+        self, request, rid, cfg, log, session, tracer, deadline, retry,
+        injector,
+    ) -> RequestContext:
+        return RequestContext(
+            cfg=cfg,
+            log=log,
+            request_id=rid,
+            tenant=request.tenant,
+            warm_store=session.warm_store_for(rid),
+            session=session,
+            batcher=self.batcher,
+            tracer=tracer,
+            deadline=deadline,
+            retry=retry,
+            injector=injector,
+        )
 
     def _fingerprint(self, request: SelectionRequest, dense, cfg: Config) -> str:
         from citizensassemblies_tpu.utils.checkpoint import problem_fingerprint
@@ -449,6 +639,11 @@ class SelectionService:
 
     def _execute(self, request: SelectionRequest, dense, space, ctx, fp: str):
         """Run the request's algorithm with the context installed."""
+        from citizensassemblies_tpu.robust import inject
+
+        # chaos: a worker crash at execution start is the canonical
+        # transient fault — the retry loop above absorbs it
+        inject.raise_if("worker_crash", ctx.log)
         algo = request.algorithm
         if algo == "legacy":
             from citizensassemblies_tpu.models.legacy import legacy_probabilities
@@ -529,6 +724,15 @@ class SelectionService:
             audit["tenant_memo_evictions"] = memo_evictions_by_owner().get(
                 ctx.session.owner, 0
             )
+        # graftfault evidence: retries taken, deadline headroom, and (chaos
+        # runs) the injector's deterministic fire schedule — every recovery
+        # counter (sentinel_*, robust_*, fault_*) is already in "counters"
+        if ctx.retry is not None and ctx.retry.used:
+            audit["retries_used"] = int(ctx.retry.used)
+        if ctx.deadline is not None:
+            audit["deadline_remaining_s"] = round(ctx.deadline.remaining(), 3)
+        if ctx.injector is not None:
+            audit["faults"] = ctx.injector.stats()
         if ctx.tracer is not None:
             from citizensassemblies_tpu.obs.trace import TRACE_SCHEMA_VERSION
 

@@ -47,6 +47,11 @@ class TenantSession:
         self.memo: LRU = LRU(cap=cap, name=f"{self.owner}:memo")
         #: content hash → EllPack
         self.packs: LRU = LRU(cap=cap, name=f"{self.owner}:packs")
+        #: pack keys written per in-flight request (request_id → [keys]) —
+        #: the rollback ledger: a request that fails mid-solve may have
+        #: half-useful packs in the session, and its teardown removes
+        #: exactly what it wrote (``rollback_request``)
+        self._pack_writes: Dict[str, list] = {}
         self.memo_hits = 0
         self.pack_hits = 0
 
@@ -85,9 +90,28 @@ class TenantSession:
                 self.pack_hits += 1
             return hit
 
-    def pack_put(self, key: str, pack) -> None:
+    def pack_put(self, key: str, pack, request_id: Optional[str] = None) -> None:
         with self._lock:
             self.packs.put(key, pack, owner=self.owner)
+            if request_id is not None:
+                self._pack_writes.setdefault(request_id, []).append(key)
+
+    # --- request-scoped rollback (robust) -----------------------------------
+
+    def finish_request(self, request_id: str) -> None:
+        """Success path: the request's writes become durable session state —
+        drop its rollback ledger and keep everything it cached."""
+        with self._lock:
+            self._pack_writes.pop(request_id, None)
+
+    def rollback_request(self, request_id: str) -> None:
+        """Failure path: remove the request's warm-slot store and every
+        session pack it wrote — an aborted request must leave no
+        half-written warm state behind (``RequestContext.teardown``)."""
+        with self._lock:
+            self.warm_stores.pop(request_id, None)
+            for key in self._pack_writes.pop(request_id, []):
+                self.packs.pop(key, None)
 
     def stats(self) -> Dict[str, int]:
         """Session-level accounting for the audit stamp."""

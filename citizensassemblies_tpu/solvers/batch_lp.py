@@ -208,16 +208,21 @@ def _resolve_warm(warm_key: Optional[str]):
     return store, ctx.scoped_warm_key(warm_key)
 
 
-def _get_batch_core(max_iters: int, check_every: int):
+def _get_batch_core(max_iters: int, check_every: int, sentinel: bool = False):
     """Build (once per iteration schedule) the jitted vmapped PDHG core.
 
     The per-lane body is the serial solver's ``_pdhg_body`` verbatim —
     ``vmap`` adds the batch axis, the jit wrapper donates the stacked warm
     carry, and the while_loop batching rule supplies the per-instance
     convergence masks (a finished lane's carry is select-frozen while the
-    bucket runs on).
+    bucket runs on). With ``sentinel`` (``Config.robust_sentinels``) the
+    body additionally carries the per-lane QUARANTINE flag: a lane whose
+    residual goes non-finite freezes at its last finite iterate and exits —
+    NaN cannot propagate through the fleet, and the caller re-solves flagged
+    lanes on the serial float64 host path. One run uses one flag value, so
+    the compile count per bucket is unchanged.
     """
-    key = (int(max_iters), int(check_every))
+    key = (int(max_iters), int(check_every), bool(sentinel))
     core = _BATCH_CORES.get(key)
     if core is None:
         from functools import partial
@@ -226,7 +231,9 @@ def _get_batch_core(max_iters: int, check_every: int):
 
         from citizensassemblies_tpu.solvers.lp_pdhg import _pdhg_body
 
-        one = partial(_pdhg_body, max_iters=key[0], check_every=key[1])
+        one = partial(
+            _pdhg_body, max_iters=key[0], check_every=key[1], sentinel=key[2]
+        )
         core = jax.jit(jax.vmap(one), donate_argnums=(5, 6, 7))
         _BATCH_CORES[key] = core
     return core
@@ -378,9 +385,24 @@ def solve_lp_batch(
             key = _bucket_key([inst], cap)
             groups.setdefault(key, []).append(i)
 
+    from citizensassemblies_tpu.robust import inject
+    from citizensassemblies_tpu.solvers.lp_pdhg import (
+        FLAG_POISONED,
+        _host_resolve_lp,
+        sentinels_enabled,
+    )
+
+    sent = sentinels_enabled(cfg)
+    # fault/sentinel evidence must survive even when the caller passes no
+    # log (the cross-request batcher dispatches with log=None and fans
+    # per-request counters back itself): attribute to the ambient request
+    fault_log = log
+    if fault_log is None:
+        _ctx_amb = _current_context()
+        fault_log = _ctx_amb.log if _ctx_amb is not None else None
     out: List[Optional[LPSolution]] = [None] * len(problems)
     warm_store, warm_key = _resolve_warm(warm_key)
-    core = _get_batch_core(iters, check_every)
+    core = _get_batch_core(iters, check_every, sentinel=sent)
     for (m1, m2, nv), idxs in groups.items():
         B_real = len(idxs)
         B = 1 << max(B_real - 1, 0).bit_length()  # pow-2 batch, floor 1
@@ -413,6 +435,14 @@ def solve_lp_batch(
                 if slot is not None:
                     warm = slot[:3]
                     warm_hits += 1
+                    if inject.site("warm_slot_corrupt", fault_log):
+                        # chaos: a corrupt slot must be quarantined by the
+                        # lane sentinel, not poison the fleet
+                        bad = np.array(warm[0], dtype=np.float64)
+                        bad[:1] = np.nan
+                        warm = (bad, warm[1], warm[2])
+            if warm is None and inject.site("pdhg_nan", fault_log):
+                x0[lane, 0] = np.nan  # chaos: poison one cold lane
             if warm is not None:
                 # re-pad at the instance's REAL sizes (tail variables keep
                 # their structural position inside the real column block —
@@ -449,7 +479,13 @@ def solve_lp_batch(
         ) as _ds:
             with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
                 with no_implicit_transfers(cfg):
-                    x, lam, mu, it, res = core(*operands)
+                    core_out = core(*operands)
+                x, lam, mu, it, res = core_out[:5]
+                flags = (
+                    np.asarray(core_out[5])
+                    if sent
+                    else np.zeros(B, dtype=np.int32)
+                )
                 x = np.asarray(x, dtype=np.float64)
                 lam = np.asarray(lam, dtype=np.float64)
                 mu = np.asarray(mu, dtype=np.float64)
@@ -476,13 +512,27 @@ def solve_lp_batch(
         for lane, i in enumerate(idxs):
             inst = problems[i]
             nvi, m1i, m2i = inst.c.shape[0], inst.G.shape[0], inst.A.shape[0]
+            if int(flags[lane]) & FLAG_POISONED:
+                # per-lane quarantine: the lane froze at its last finite
+                # iterate; re-solve THIS instance on the serial float64
+                # host path (the fleet's other lanes are untouched) and do
+                # NOT write its warm slot (the frozen iterate is suspect)
+                if fault_log is not None:
+                    fault_log.count("sentinel_quarantined")
+                host = _host_resolve_lp(inst.c, inst.G, inst.h, inst.A, inst.b)
+                if host is not None:
+                    if fault_log is not None:
+                        fault_log.count("sentinel_host_resolve")
+                    out[i] = host
+                    continue
             xi = x[lane, :nvi]
             li = lam[lane, :m1i]
             mi = mu[lane, :m2i]
             res_i = float(res[lane])
             tol_i = float(tols[lane])
+            poisoned = bool(int(flags[lane]) & FLAG_POISONED)
             out[i] = LPSolution(
-                ok=bool(res_i <= tol_i * 4.0),  # same accept band as solve_lp
+                ok=bool(res_i <= tol_i * 4.0) and not poisoned,
                 x=xi,
                 lam=li,
                 mu=mi,
@@ -490,7 +540,7 @@ def solve_lp_batch(
                 iters=int(it[lane]),
                 kkt=res_i,
             )
-            if warm_key is not None:
+            if warm_key is not None and not poisoned:
                 warm_store.put((warm_key, i), (xi, li, mi, int(inst.tail_vars)))
     return out
 
@@ -502,7 +552,9 @@ def solve_lp_batch(
 _POLISH_ELL_CORES: LRU = LRU(cap=6, name="polish_ell_cores")
 
 
-def _get_polish_screen_ell_core(max_iters: int, check_every: int):
+def _get_polish_screen_ell_core(
+    max_iters: int, check_every: int, sentinel: bool = False
+):
     """Build (once per schedule) the vmapped ELL two-sided master core.
 
     The per-lane body is ``lp_pdhg._pdhg_two_sided_body_ell`` verbatim;
@@ -512,7 +564,7 @@ def _get_polish_screen_ell_core(max_iters: int, check_every: int):
     pack feeds every lane and the whole screen is one device dispatch over
     O(C·k_pad) data instead of a stacked dense ``[B, 2T, C+1]`` tensor.
     """
-    key = (int(max_iters), int(check_every))
+    key = (int(max_iters), int(check_every), bool(sentinel))
     core = _POLISH_ELL_CORES.get(key)
     if core is None:
         from functools import partial
@@ -524,7 +576,8 @@ def _get_polish_screen_ell_core(max_iters: int, check_every: int):
         )
 
         one = partial(
-            _pdhg_two_sided_body_ell, max_iters=key[0], check_every=key[1]
+            _pdhg_two_sided_body_ell, max_iters=key[0], check_every=key[1],
+            sentinel=key[2],
         )
         core = jax.jit(
             jax.vmap(one, in_axes=(None, None, None, 0, 0, 0, 0, 0)),
@@ -640,7 +693,15 @@ def solve_polish_screen_ell(
             lam0[lane, : min(2 * T, len(l_w))] = l_w[: 2 * T]
             mu0[lane] = float(m_w[0] if np.ndim(m_w) else m_w)
 
-    core = _get_polish_screen_ell_core(int(max_iters), int(cfg.pdhg_check_every))
+    from citizensassemblies_tpu.solvers.lp_pdhg import (
+        FLAG_POISONED,
+        sentinels_enabled,
+    )
+
+    sent = sentinels_enabled(cfg)
+    core = _get_polish_screen_ell_core(
+        int(max_iters), int(cfg.pdhg_check_every), sentinel=sent
+    )
     bkey = f"ell_{T}x{Cp}x{ell.k_pad}x{B}"
     operands = (
         jnp.asarray(idx_p), jnp.asarray(val_p), jnp.asarray(v, jnp.float32),
@@ -653,7 +714,11 @@ def solve_polish_screen_ell(
     ) as _ds:
         with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
             with no_implicit_transfers(cfg):
-                x, lam, mu, it, res = core(*operands)
+                core_out = core(*operands)
+            x, lam, mu, it, res = core_out[:5]
+            flags = (
+                np.asarray(core_out[5]) if sent else np.zeros(B, dtype=np.int32)
+            )
             x = np.asarray(x, dtype=np.float64)
             lam = np.asarray(lam, dtype=np.float64)
             mu = np.asarray(mu, dtype=np.float64)
@@ -677,9 +742,16 @@ def solve_polish_screen_ell(
     out = []
     for lane, c_ in enumerate(caps):
         res_l = float(res[lane])
+        poisoned = bool(int(flags[lane]) & FLAG_POISONED)
+        if poisoned and log is not None:
+            # the screen is advisory: a quarantined prefix lane is simply
+            # not a candidate (its frozen iterate fails the caller's own
+            # float64 accept check) — the deep polish / host IPM fallback
+            # already covers the miss, so no host re-solve here
+            log.count("sentinel_quarantined")
         out.append(
             LPSolution(
-                ok=bool(res_l <= float(tol) * 4.0),
+                ok=bool(res_l <= float(tol) * 4.0) and not poisoned,
                 x=x[lane],
                 lam=lam[lane],
                 mu=mu[lane][None] if np.ndim(mu[lane]) == 0 else mu[lane],

@@ -59,6 +59,8 @@ import numpy as np
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.obs.trace import begin_span, end_span
+from citizensassemblies_tpu.robust import inject
+from citizensassemblies_tpu.robust.checkpoint import FaceCheckpointer
 from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
 from citizensassemblies_tpu.utils.logging import RunLog
@@ -853,6 +855,10 @@ class _AnchorPricer:
         self.red = reduction
         self.log = log
         self.device = device
+        # fault injection rides a ContextVar; the overlap worker thread is
+        # outside the request's context scope, so capture the injector here
+        # (on the constructing thread) and consult it explicitly
+        self._inj = inject.active_injector()
         self._pool = (
             ThreadPoolExecutor(max_workers=1, thread_name_prefix="anchor-pricer")
             if overlap and device is None
@@ -863,12 +869,29 @@ class _AnchorPricer:
     def _run(self, tasks) -> List[np.ndarray]:
         out = []
         for weights, forced in tasks:
-            # 1 % MILP gap: anchor optimality buys nothing (see the caller's
-            # acceptance semantics) and the gap cuts the anchor share of the
-            # decomposition wall-clock ~20 % on the flagship
-            got = self.oracle.maximize(weights, forced_type=forced, rel_gap=1e-2)
-            if got is not None:
-                out.append(got[0][None, :].astype(np.int16))
+            # oracle backend failures (injected or real) retry once, then
+            # SKIP the task: anchors are heuristic columns — acceptance is
+            # the master iterate's arithmetic residual, so a missing anchor
+            # costs at most convergence speed, never exactness
+            for attempt in (0, 1):
+                try:
+                    inject.raise_if("oracle_raise", self.log, inj=self._inj)
+                    # 1 % MILP gap: anchor optimality buys nothing (see the
+                    # caller's acceptance semantics) and the gap cuts the
+                    # anchor share of the decomposition wall-clock ~20 % on
+                    # the flagship
+                    got = self.oracle.maximize(
+                        weights, forced_type=forced, rel_gap=1e-2
+                    )
+                    if got is not None:
+                        out.append(got[0][None, :].astype(np.int16))
+                    break
+                except Exception:
+                    if self.log is not None:
+                        self.log.count(
+                            "robust_oracle_skip" if attempt
+                            else "robust_oracle_retry"
+                        )
         return out
 
     def submit(
@@ -904,8 +927,20 @@ class _AnchorPricer:
         if self.device is not None:
             # the accelerator is the worker: one async dispatch prices the
             # whole batch; the handle is decoded at the next harvest
-            self._pending = ("device", self.device.dispatch(tasks), tasks)
-        elif self._pool is not None:
+            try:
+                inject.raise_if("device_dispatch", self.log, inj=self._inj)
+                self._pending = ("device", self.device.dispatch(tasks), tasks)
+                return
+            except Exception:
+                # device-pricing dispatch failed (injected or real): walk
+                # the ladder's first rung — the exact host MILP carries the
+                # rest of the run (the device screen only ever REDUCED host
+                # work, so dropping it is a pure slowdown, never a
+                # correctness change)
+                if self.log is not None:
+                    self.log.count("robust_degrade_device_pricing")
+                self.device = None
+        if self._pool is not None:
             self._pending = self._pool.submit(self._run, tasks)
         else:
             self._pending = self._run(tasks)
@@ -1065,6 +1100,22 @@ def realize_profile(
         seen[kb] = len(cols)
         cols.append(c.astype(np.int16))
         return True
+
+    # --- crash-consistent checkpointing (robust/checkpoint) ----------------
+    # the loop's certified state (columns + mixture + arithmetic ε) saves
+    # every N rounds; a matching snapshot resumes HERE — its columns seed
+    # the hull FIRST (so its mixture maps positionally onto the warm start)
+    # and the seeds dedup in behind them
+    _ckpt = FaceCheckpointer(cfg, reduction, v, accept)
+    _resume = _ckpt.load(T) if _ckpt.enabled else None
+    if _resume is not None:
+        for c in _resume.compositions:
+            add(c)
+        log.count("robust_resume")
+        log.emit(
+            f"  face checkpoint resumed: {len(cols)} columns from round "
+            f"{_resume.round} (eps {_resume.eps:.2e})."
+        )
 
     for c in seed_comps:
         add(c)
@@ -1301,6 +1352,18 @@ def realize_profile(
     rng = np.random.default_rng(0)
     eps_hist: List[float] = []
     pdhg_warm = None
+    if _resume is not None and len(_resume.probabilities) <= len(cols):
+        # warm the first master from the checkpointed mixture: its columns
+        # were added first, so the probabilities map positionally; the ε
+        # slot carries the certified residual at save time
+        x_w = np.zeros(len(cols) + 1)
+        x_w[: len(_resume.probabilities)] = _resume.probabilities
+        x_w[-1] = max(float(_resume.eps), 0.0)
+        pdhg_warm = (x_w, np.zeros(2 * T), np.zeros(1))
+    #: per-request deadline (robust/policy), threaded through the ambient
+    #: RequestContext — checked once per round below, at the round's
+    #: existing host sync point (a host clock read: no new device syncs)
+    deadline = getattr(ctx, "deadline", None) if ctx is not None else None
     best: Optional[Tuple[np.ndarray, np.ndarray, float]] = None
     t_start = time.time()
     # the stalled-acceptance band the caller still accepts (cg_typespace
@@ -1401,6 +1464,20 @@ def realize_profile(
             t_round = time.time()
             end_span(_round_span, log=log)
             _round_span = begin_span("decomp_round", log=log, round=rnd)
+            # robustness gates, once per round at the round's host boundary:
+            # the deadline check is a host clock read (raises a graceful
+            # DeadlineExceeded with the best-so-far evidence instead of
+            # grinding past the budget), and face_abort is the chaos kill
+            # switch the checkpoint/resume contract is tested against
+            if deadline is not None:
+                deadline.check(
+                    "face_decompose round", log=log,
+                    partial={
+                        "decomp_rounds": rnd,
+                        "best_eps": float(best[2]) if best is not None else None,
+                    },
+                )
+            inject.raise_if("face_abort", log)
             # stall detection on the RUNNING BEST: the per-round arithmetic
             # eps of a first-order iterate wobbles +-30 %, and comparing raw
             # values made noisy upticks read as a stall while the hull was
@@ -1505,6 +1582,19 @@ def realize_profile(
                     # pricing mode the fused screen and the lagged anchor
                     # batch piggyback on this same synchronization point)
                     log.count("decomp_host_syncs")
+                    if not np.isfinite(eps):
+                        # quarantined/poisoned master (the sentinel froze the
+                        # lane, or its mixture went non-finite): re-solve
+                        # THIS round on the serial float64 host path — the
+                        # certified ladder rung — and cold-start the next
+                        # device master
+                        log.count("sentinel_quarantined")
+                        log.count("robust_host_resolve")
+                        with log.timer("decomp_master"):
+                            eps, w, _mu_h, p = _decomp_lp(MT, v)
+                        eps_obj = float(eps)
+                        pdhg_warm = None
+                        lp_solves += 1
                     polish_warm = pdhg_warm
                     if not warm_enabled:
                         pdhg_warm = None
@@ -1552,6 +1642,7 @@ def realize_profile(
                             f"{len(C_sup)} support columns ({lp_solves} master solves, "
                             f"end-game polish)."
                         )
+                        _ckpt.clear()  # certified: no stale resume point
                         return C_sup, p_sup, eps_sup, lp_solves
                     # discard the failed polish value: it is the optimum of a
                     # support SUBSET, not something the full-column iterate
@@ -1566,6 +1657,11 @@ def realize_profile(
             eps_hist.append(eps)
             if best is None or eps < best[2]:
                 best = (C, p, eps)
+            if best is not None and len(best[1]) == len(best[0]):
+                # snapshot the RUNNING BEST (already certified by its
+                # arithmetic residual) at the round boundary — a killed
+                # request resumes from here instead of restarting
+                _ckpt.maybe_save(rnd, best[0], best[1], best[2], log=log)
             if (
                 time.time() - t_start > cfg.decomp_time_budget_s
                 and best[2] <= stalled_band
@@ -1586,6 +1682,7 @@ def realize_profile(
                     f"Face decomposition: eps = {eps:.2e} certified on {len(cols)} "
                     f"columns ({lp_solves} master solves)."
                 )
+                _ckpt.clear()  # certified: no stale resume point
                 return C.astype(np.int32), p, float(eps), lp_solves
             # the eps-LP duals w (= y_lo - y_up) mark over-served (w < 0) vs
             # under-served (w > 0) types; move units down the gradient
@@ -1726,6 +1823,7 @@ def realize_profile(
             f"Face decomposition: eps = {eps:.2e} on {len(C_sup)} support columns "
             f"({lp_solves} master solves)."
         )
+        _ckpt.clear()  # the loop ran to completion: no stale resume point
         return C_sup, p_sup, float(eps), lp_solves
     finally:
         # a certified in-loop return leaves the current round span open —

@@ -52,6 +52,111 @@ class LPSolution:
     kkt: float  # final combined relative KKT residual
 
 
+# --- numerical sentinels (robust/) ------------------------------------------
+# With ``Config.robust_sentinels`` on, every PDHG while_loop carries a
+# per-lane quarantine flag: a block whose KKT residual goes non-finite is
+# REJECTED (the carry freezes at the last finite iterate — the same select
+# pattern as the batched engine's convergence masks), the lane exits with
+# bit 1 set, and the wrapper re-solves it on the serial float64 host path.
+# Bit 2 is the report-only stall flag: _STALL_BLOCKS consecutive checks
+# without a new best residual. Zero-fault runs are bit-identical with the
+# sentinel on or off (the selects always take the freshly-computed branch),
+# and the flag is STATIC, so one run compiles exactly as many programs as
+# before.
+
+#: consecutive convergence checks without a new best residual before the
+#: stall bit is reported (8k iterations at the default check_every=128)
+_STALL_BLOCKS = 64
+
+#: quarantine-flag bits
+FLAG_POISONED = 1
+FLAG_STALLED = 2
+
+
+def sentinels_enabled(cfg: Optional[Config]) -> bool:
+    cfg = cfg or default_config()
+    return bool(getattr(cfg, "robust_sentinels", True))
+
+
+def _ambient_log():
+    """The ambient request's RunLog (for quarantine counters), or None —
+    imported lazily to keep this module importable without the service."""
+    from citizensassemblies_tpu.service.context import current_context
+
+    ctx = current_context()
+    return ctx.log if ctx is not None else None
+
+
+def _sentinel_while(cond, block, state0):
+    """Run ``while_loop(cond, block, state0)`` under the quarantine wrapper.
+
+    ``state0`` is the unsentineled carry whose residual sits at index -2
+    (the shared (…, it, res, omega) tail of every PDHG loop here). Returns
+    ``(final_inner_state, flags)`` with flags an int32 bitmask.
+    """
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    n = len(state0)
+
+    def s_block(state):
+        inner = state[:n]
+        flags, best, since = state[n], state[n + 1], state[n + 2]
+        new = block(inner)
+        res_n = new[n - 2]
+        ok = _jnp.isfinite(res_n)
+        merged = tuple(_jnp.where(ok, a, b) for a, b in zip(new, inner))
+        improved = ok & (res_n < best)
+        best = _jnp.where(improved, res_n, best)
+        since = _jnp.where(improved, _jnp.int32(0), since + 1)
+        flags = flags | _jnp.where(ok, 0, FLAG_POISONED).astype(_jnp.int32)
+        flags = flags | _jnp.where(
+            since >= _STALL_BLOCKS, FLAG_STALLED, 0
+        ).astype(_jnp.int32)
+        return merged + (flags, best, since)
+
+    def s_cond(state):
+        return cond(state[:n]) & ((state[n] & FLAG_POISONED) == 0)
+
+    s0 = tuple(state0) + (
+        _jnp.int32(0), _jnp.float32(_jnp.inf), _jnp.int32(0),
+    )
+    out = _jax.lax.while_loop(s_cond, s_block, s0)
+    return out[:n], out[n]
+
+
+def _host_resolve_lp(c, G, h, A, b) -> Optional["LPSolution"]:
+    """Serial float64 host re-solve of a quarantined lane (scipy/HiGHS via
+    the presolve/method retry ladder). Returns None when the host solver
+    also fails — the caller then ships the frozen iterate with ok=False."""
+    from citizensassemblies_tpu.solvers.lp_util import robust_linprog
+
+    c64 = np.asarray(c, dtype=np.float64)
+    res = robust_linprog(
+        c64,
+        A_ub=np.asarray(G, dtype=np.float64),
+        b_ub=np.asarray(h, dtype=np.float64),
+        A_eq=np.asarray(A, dtype=np.float64),
+        b_eq=np.asarray(b, dtype=np.float64),
+        bounds=(0, None),
+    )
+    if res is None or res.status != 0:
+        return None
+    x = np.asarray(res.x, dtype=np.float64)
+    lam = np.zeros(np.shape(G)[0])
+    mu = np.zeros(np.shape(A)[0])
+    try:
+        # scipy/HiGHS marginals: ≤ 0 for A_ub rows of a min problem
+        lam = np.maximum(-np.asarray(res.ineqlin.marginals, np.float64), 0.0)
+        mu = -np.asarray(res.eqlin.marginals, np.float64)
+    except Exception:  # marginals missing on some method fallbacks
+        pass
+    return LPSolution(
+        ok=True, x=x, lam=lam, mu=mu, objective=float(c64 @ x), iters=-1,
+        kkt=0.0,
+    )
+
+
 def _ruiz_equilibrate(K: jnp.ndarray, iters: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Diagonal row/column scalings d_r, d_c with D_r K D_c ≈ unit row/col
     ∞-norms (Ruiz 2001). Returns (d_r[m], d_c[nv])."""
@@ -108,7 +213,10 @@ def _kkt_residual(c, G, h, A, b, x, lam, mu, scale):
     return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
 
 
-def _pdhg_body(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: int):
+def _pdhg_body(
+    c, G, h, A, b, x0, lam0, mu0, tol,
+    max_iters: int, check_every: int, sentinel: bool = False,
+):
     m1, nv = G.shape
     m2 = A.shape[0]
     K = jnp.concatenate([G, A], axis=0)
@@ -196,12 +304,25 @@ def _pdhg_body(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: i
         x, lam, mu, x, lam, mu, jnp.int32(0), jnp.float32(jnp.inf),
         jnp.float32(1.0),
     )
-    x, lam, mu, _, _, _, it, res, _omega = jax.lax.while_loop(cond, block, state0)
+    if sentinel:
+        # non-finite carries freeze at the last finite iterate and exit the
+        # lane with the poisoned flag set (see _sentinel_while) — the
+        # all-finite trajectory is untouched, so zero-fault runs are
+        # bit-identical to sentinel=False
+        (x, lam, mu, _, _, _, it, res, _omega), flags = _sentinel_while(
+            cond, block, state0
+        )
+    else:
+        x, lam, mu, _, _, _, it, res, _omega = jax.lax.while_loop(
+            cond, block, state0
+        )
 
     # unscale
     x_out = x * d_c
     lam_out = lam * d_r[:m1]
     mu_out = mu * d_r[m1:]
+    if sentinel:
+        return x_out, lam_out, mu_out, it, res, flags
     return x_out, lam_out, mu_out, it, res
 
 
@@ -216,7 +337,7 @@ def _pdhg_body(c, G, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: i
 # padded instance bucket — one math definition, two dispatch shapes.
 _pdhg_core = partial(
     jax.jit,
-    static_argnames=("max_iters", "check_every"),
+    static_argnames=("max_iters", "check_every", "sentinel"),
     donate_argnums=(5, 6, 7),
 )(_pdhg_body)
 
@@ -237,21 +358,33 @@ def solve_lp(
     rounds the dual LP only gains rows, so the previous optimum is an
     excellent starting point.
     """
+    from citizensassemblies_tpu.robust import inject
+
     cfg = cfg or default_config()
     tol = float(tol if tol is not None else cfg.pdhg_tol)
+    sent = sentinels_enabled(cfg)
     f32 = jnp.float32
     c_, G_, h_ = jnp.asarray(c, f32), jnp.asarray(G, f32), jnp.asarray(h, f32)
     A_, b_ = jnp.asarray(A, f32), jnp.asarray(b, f32)
     nv = c_.shape[0]
     m1, m2 = G_.shape[0], A_.shape[0]
     if warm is not None:
-        x0 = jnp.asarray(warm[0], f32)
-        lam0 = jnp.asarray(warm[1], f32)
-        mu0 = jnp.asarray(warm[2], f32)
+        x0_h = np.asarray(warm[0], np.float32)
+        lam0_h = np.asarray(warm[1], np.float32)
+        mu0_h = np.asarray(warm[2], np.float32)
     else:
-        x0 = jnp.zeros(nv, f32)
-        lam0 = jnp.zeros(m1, f32)
-        mu0 = jnp.zeros(m2, f32)
+        x0_h = np.zeros(nv, np.float32)
+        lam0_h = np.zeros(m1, np.float32)
+        mu0_h = np.zeros(m2, np.float32)
+    log = _ambient_log()
+    if inject.site("pdhg_nan", log):
+        # chaos: poison the lane's warm start — the in-loop sentinel must
+        # quarantine it and the host re-solve below must recover
+        x0_h = x0_h.copy()
+        x0_h[0] = np.nan
+    x0 = jnp.asarray(x0_h)
+    lam0 = jnp.asarray(lam0_h)
+    mu0 = jnp.asarray(mu0_h)
     # inputs are explicitly materialized above (a bare np.float32 scalar for
     # tol would itself be an implicit transfer); inside the guard a stray
     # numpy operand re-uploaded per CG round raises
@@ -260,17 +393,33 @@ def solve_lp(
         "lp_pdhg.pdhg_core", cfg=cfg, nv=int(nv), m1=int(m1), m2=int(m2)
     ) as _ds:
         with no_implicit_transfers(cfg):
-            x, lam, mu, it, res = _pdhg_core(
+            out = _pdhg_core(
                 c_, G_, h_, A_, b_, x0, lam0, mu0, tol_,
-                max_iters=int(cfg.pdhg_max_iters), check_every=int(cfg.pdhg_check_every),
+                max_iters=int(cfg.pdhg_max_iters),
+                check_every=int(cfg.pdhg_check_every),
+                sentinel=sent,
             )
+        x, lam, mu, it, res = out[:5]
         _ds.out = (x, lam, mu, it, res)
+    flags = int(np.asarray(out[5])) if sent else 0
+    if flags & FLAG_POISONED:
+        # quarantine: the lane froze at its last finite iterate — re-solve
+        # on the serial float64 host path (certified; NaN never escapes)
+        if log is not None:
+            log.count("sentinel_poisoned")
+        host = _host_resolve_lp(c, G, h, A, b)
+        if host is not None:
+            if log is not None:
+                log.count("sentinel_host_resolve")
+            return host
+    if flags & FLAG_STALLED and log is not None:
+        log.count("sentinel_stalled")
     x = np.asarray(x, dtype=np.float64)
     lam = np.asarray(lam, dtype=np.float64)
     mu = np.asarray(mu, dtype=np.float64)
     res_f = float(res)
     return LPSolution(
-        ok=bool(res_f <= tol * 4.0),  # accept near-tolerance finishes
+        ok=bool(res_f <= tol * 4.0) and not (flags & FLAG_POISONED),
         x=x,
         lam=lam,
         mu=mu,
@@ -286,6 +435,7 @@ def solve_lp(
 def _two_sided_iterate(
     K_apply, KT_apply, cs_eps, hs_lo, hs_up, bs,
     p, eps, l_lo, l_up, mu, tol, max_iters: int, check_every: int,
+    sentinel: bool = False,
 ):
     """The restart-to-average PDHG loop of the two-sided ε master, generic
     over the structured operator pair ``(K_apply, KT_apply)`` — ONE loop
@@ -401,6 +551,14 @@ def _two_sided_iterate(
         p, eps, l_lo, l_up, mu, p, eps, l_lo, l_up, mu,
         jnp.int32(0), jnp.float32(jnp.inf), jnp.float32(1.0),
     )
+    if sentinel:
+        # the shared (…, it, res, omega) carry tail puts res at index -2,
+        # which is all the quarantine wrapper needs (see _sentinel_while)
+        (p, eps, l_lo, l_up, mu, *_rest), flags = _sentinel_while(
+            cond, block, state0
+        )
+        it, res = _rest[5], _rest[6]
+        return p, eps, l_lo, l_up, mu, it, res, flags
     (p, eps, l_lo, l_up, mu, *_rest) = jax.lax.while_loop(cond, block, state0)
     it, res = _rest[5], _rest[6]
     return p, eps, l_lo, l_up, mu, it, res
@@ -410,11 +568,12 @@ def _two_sided_iterate(
 # output, so donating it would only be rejected)
 @partial(
     jax.jit,
-    static_argnames=("max_iters", "check_every"),
+    static_argnames=("max_iters", "check_every", "sentinel"),
     donate_argnums=(3, 4),
 )
 def _pdhg_two_sided_core(
-    MT, v, colmask, x0, lam0, mu0, tol, max_iters: int, check_every: int
+    MT, v, colmask, x0, lam0, mu0, tol, max_iters: int, check_every: int,
+    sentinel: bool = False,
 ):
     """PDHG specialized to the face-decomposition master
 
@@ -493,19 +652,24 @@ def _pdhg_two_sided_core(
     l_up = jnp.maximum(lam0[T:] / jnp.maximum(d_r, 1e-12), 0.0)
     mu = mu0 / jnp.maximum(d_e, 1e-12)
 
-    p, eps, l_lo, l_up, mu, it, res = _two_sided_iterate(
+    out = _two_sided_iterate(
         K_apply, KT_apply, cs_eps, hs_lo, hs_up, bs,
         p, eps, l_lo, l_up, mu, tol, max_iters, check_every,
+        sentinel=sentinel,
     )
+    p, eps, l_lo, l_up, mu, it, res = out[:7]
 
     x_out = jnp.concatenate([p * d_c, (eps * d_eps)[None]])
     lam_out = jnp.concatenate([l_lo * d_r, l_up * d_r])
     mu_out = (mu * d_e)[None]
+    if sentinel:
+        return x_out, lam_out, mu_out, it, res, out[7]
     return x_out, lam_out, mu_out, it, res
 
 
 def _pdhg_two_sided_body_ell(
-    idx, val, v, colmask, x0, lam0, mu0, tol, max_iters: int, check_every: int
+    idx, val, v, colmask, x0, lam0, mu0, tol, max_iters: int, check_every: int,
+    sentinel: bool = False,
 ):
     """The two-sided ε master on the ELL rep — same LP, same loop
     (:func:`_two_sided_iterate`), sparse matvecs.
@@ -584,14 +748,18 @@ def _pdhg_two_sided_body_ell(
     l_up = jnp.maximum(lam0[T:] / jnp.maximum(d_r, 1e-12), 0.0)
     mu = mu0 / jnp.maximum(d_e, 1e-12)
 
-    p, eps, l_lo, l_up, mu, it, res = _two_sided_iterate(
+    out = _two_sided_iterate(
         K_apply, KT_apply, cs_eps, hs_lo, hs_up, bs,
         p, eps, l_lo, l_up, mu, tol, max_iters, check_every,
+        sentinel=sentinel,
     )
+    p, eps, l_lo, l_up, mu, it, res = out[:7]
 
     x_out = jnp.concatenate([p * d_c, (eps * d_eps)[None]])
     lam_out = jnp.concatenate([l_lo * d_r, l_up * d_r])
     mu_out = (mu * d_e)[None]
+    if sentinel:
+        return x_out, lam_out, mu_out, it, res, out[7]
     return x_out, lam_out, mu_out, it, res
 
 
@@ -599,7 +767,7 @@ def _pdhg_two_sided_body_ell(
 # ``vmap`` the identical ELL iteration over prefix lanes (solvers/batch_lp)
 _pdhg_two_sided_core_ell = partial(
     jax.jit,
-    static_argnames=("max_iters", "check_every"),
+    static_argnames=("max_iters", "check_every", "sentinel"),
     donate_argnums=(4, 5),  # x0, lam0 (mu0 is a scalar, undonated by design)
 )(_pdhg_two_sided_body_ell)
 
@@ -619,16 +787,27 @@ class MasterHandle:
     res: object  # f32 device scalar
     Cp: int
     tol: float
+    #: sentinel quarantine bitmask (i32 device scalar) when the solve ran
+    #: with the numerical sentinel, else None
+    flags: object = None
 
 
 def finish_two_sided_master(h: MasterHandle) -> LPSolution:
-    """Blocking readback half of the async master solve."""
+    """Blocking readback half of the async master solve. A sentinel-
+    quarantined solve comes back with ``ok=False`` (its iterate froze at the
+    last finite block) — ``_master_pdhg`` then routes the round to the
+    serial float64 host master."""
     x = np.asarray(h.x, dtype=np.float64)
     lam = np.asarray(h.lam, dtype=np.float64)
     mu = np.asarray(h.mu, dtype=np.float64)
     res_f = float(h.res)
+    poisoned = (
+        bool(int(np.asarray(h.flags)) & FLAG_POISONED)
+        if h.flags is not None
+        else False
+    )
     return LPSolution(
-        ok=bool(res_f <= h.tol * 4.0),
+        ok=bool(res_f <= h.tol * 4.0) and not poisoned,
         x=x,
         lam=lam,
         mu=mu,
@@ -650,8 +829,11 @@ def solve_two_sided_master_async(
     """Dispatch half of :func:`solve_two_sided_master`: identical operand
     prep and core call, but the outputs stay DEVICE arrays (no readback) so
     a caller can enqueue dependent device work before blocking."""
+    from citizensassemblies_tpu.robust import inject
+
     cfg = cfg or default_config()
     tol = float(tol if tol is not None else cfg.pdhg_tol)
+    sent = sentinels_enabled(cfg)
     T, C = MT.shape
     Cp = ((C + bucket - 1) // bucket) * bucket
     MTp = np.zeros((T, Cp), dtype=np.float32)
@@ -669,6 +851,8 @@ def solve_two_sided_master_async(
         x0 = np.zeros(Cp + 1, dtype=np.float32)
         lam0 = np.zeros(2 * T, dtype=np.float32)
         mu0 = np.float32(0.0)
+    if inject.site("pdhg_nan", _ambient_log()):
+        x0[0] = np.nan  # chaos: sentinel must quarantine, round must recover
     colmask = np.zeros(Cp, dtype=np.float32)
     colmask[:C] = 1.0
     # every operand is materialized to a device array BEFORE the guard scope
@@ -688,13 +872,18 @@ def solve_two_sided_master_async(
         "lp_pdhg.two_sided_core", cfg=cfg, T=int(T), cols=int(Cp)
     ) as _ds:
         with no_implicit_transfers(cfg):
-            x, lam, mu, it, res = _pdhg_two_sided_core(
+            out = _pdhg_two_sided_core(
                 *operands,
                 max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
                 check_every=int(cfg.pdhg_check_every),
+                sentinel=sent,
             )
+        x, lam, mu, it, res = out[:5]
         _ds.out = (x, lam, mu, it, res)
-    return MasterHandle(x=x, lam=lam, mu=mu, it=it, res=res, Cp=Cp, tol=tol)
+    return MasterHandle(
+        x=x, lam=lam, mu=mu, it=it, res=res, Cp=Cp, tol=tol,
+        flags=out[5] if sent else None,
+    )
 
 
 def solve_two_sided_master(
@@ -745,8 +934,11 @@ def solve_two_sided_master_ell_async(
     rows are inert), so the jitted ELL core compiles once per
     ``(T, Cp, k_pad)`` bucket.
     """
+    from citizensassemblies_tpu.robust import inject
+
     cfg = cfg or default_config()
     tol = float(tol if tol is not None else cfg.pdhg_tol)
+    sent = sentinels_enabled(cfg)
     T = int(ell.minor)
     C = len(ell)
     Cp = ((C + bucket - 1) // bucket) * bucket
@@ -764,6 +956,8 @@ def solve_two_sided_master_ell_async(
         x0 = np.zeros(Cp + 1, dtype=np.float32)
         lam0 = np.zeros(2 * T, dtype=np.float32)
         mu0 = np.float32(0.0)
+    if inject.site("pdhg_nan", _ambient_log()):
+        x0[0] = np.nan  # chaos: sentinel must quarantine, round must recover
     colmask = np.zeros(Cp, dtype=np.float32)
     colmask[:C] = 1.0
     # operands materialized BEFORE the guard scope, as in the dense wrapper
@@ -782,13 +976,18 @@ def solve_two_sided_master_ell_async(
         k_pad=int(ell.k_pad),
     ) as _ds:
         with no_implicit_transfers(cfg):
-            x, lam, mu, it, res = _pdhg_two_sided_core_ell(
+            out = _pdhg_two_sided_core_ell(
                 *operands,
                 max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
                 check_every=int(cfg.pdhg_check_every),
+                sentinel=sent,
             )
+        x, lam, mu, it, res = out[:5]
         _ds.out = (x, lam, mu, it, res)
-    return MasterHandle(x=x, lam=lam, mu=mu, it=it, res=res, Cp=Cp, tol=tol)
+    return MasterHandle(
+        x=x, lam=lam, mu=mu, it=it, res=res, Cp=Cp, tol=tol,
+        flags=out[5] if sent else None,
+    )
 
 
 def solve_two_sided_master_ell(
@@ -815,7 +1014,8 @@ def solve_two_sided_master_ell(
 
 
 def _pdhg_body_ell(
-    c, idx, val, h, A, b, x0, lam0, mu0, tol, max_iters: int, check_every: int
+    c, idx, val, h, A, b, x0, lam0, mu0, tol,
+    max_iters: int, check_every: int, sentinel: bool = False,
 ):
     """``_pdhg_body`` with the inequality block ``G`` supplied as packed ELL
     ROWS (``idx``/``val`` [m1, k_pad], minor axis = the nv variables) — the
@@ -950,13 +1150,18 @@ def _pdhg_body_ell(
         x, lam, mu, x, lam, mu, jnp.int32(0), jnp.float32(jnp.inf),
         jnp.float32(1.0),
     )
+    if sentinel:
+        (x, lam, mu, _, _, _, it, res, _omega), flags = _sentinel_while(
+            cond, block, state0
+        )
+        return x * d_c, lam * d_r[:m1], mu * d_r[m1:], it, res, flags
     x, lam, mu, _, _, _, it, res, _omega = jax.lax.while_loop(cond, block, state0)
     return x * d_c, lam * d_r[:m1], mu * d_r[m1:], it, res
 
 
 _pdhg_core_ell = partial(
     jax.jit,
-    static_argnames=("max_iters", "check_every"),
+    static_argnames=("max_iters", "check_every", "sentinel"),
     donate_argnums=(6, 7, 8),  # x0, lam0, mu0 — same carry contract
 )(_pdhg_body_ell)
 
@@ -974,21 +1179,29 @@ def solve_lp_ell(
     """:func:`solve_lp` with the inequality block packed as ELL rows
     (``ell`` an :class:`~citizensassemblies_tpu.solvers.sparse_ops.EllPack`
     over the nv variables). Same acceptance contract and warm semantics."""
+    from citizensassemblies_tpu.robust import inject
+
     cfg = cfg or default_config()
     tol = float(tol if tol is not None else cfg.pdhg_tol)
+    sent = sentinels_enabled(cfg)
     f32 = jnp.float32
     c_, h_ = jnp.asarray(c, f32), jnp.asarray(h, f32)
     A_, b_ = jnp.asarray(A, f32), jnp.asarray(b, f32)
     nv = c_.shape[0]
     m1, m2 = ell.idx.shape[0], A_.shape[0]
     if warm is not None:
-        x0 = jnp.asarray(warm[0], f32)
-        lam0 = jnp.asarray(warm[1], f32)
-        mu0 = jnp.asarray(warm[2], f32)
+        x0_h = np.asarray(warm[0], np.float32)
+        lam0_h = np.asarray(warm[1], np.float32)
+        mu0_h = np.asarray(warm[2], np.float32)
     else:
-        x0 = jnp.zeros(nv, f32)
-        lam0 = jnp.zeros(m1, f32)
-        mu0 = jnp.zeros(m2, f32)
+        x0_h = np.zeros(nv, np.float32)
+        lam0_h = np.zeros(m1, np.float32)
+        mu0_h = np.zeros(m2, np.float32)
+    log = _ambient_log()
+    if inject.site("pdhg_nan", log):
+        x0_h = x0_h.copy()
+        x0_h[0] = np.nan
+    x0, lam0, mu0 = jnp.asarray(x0_h), jnp.asarray(lam0_h), jnp.asarray(mu0_h)
     idx_d = jnp.asarray(ell.idx)
     val_d = jnp.asarray(ell.val)
     tol_ = jnp.asarray(tol, jnp.float32)
@@ -996,17 +1209,34 @@ def solve_lp_ell(
         "lp_pdhg.pdhg_core_ell", cfg=cfg, nv=int(nv), m1=int(m1), m2=int(m2)
     ) as _ds:
         with no_implicit_transfers(cfg):
-            x, lam, mu, it, res = _pdhg_core_ell(
+            out = _pdhg_core_ell(
                 c_, idx_d, val_d, h_, A_, b_, x0, lam0, mu0, tol_,
-                max_iters=int(cfg.pdhg_max_iters), check_every=int(cfg.pdhg_check_every),
+                max_iters=int(cfg.pdhg_max_iters),
+                check_every=int(cfg.pdhg_check_every),
+                sentinel=sent,
             )
+        x, lam, mu, it, res = out[:5]
         _ds.out = (x, lam, mu, it, res)
+    flags = int(np.asarray(out[5])) if sent else 0
+    if flags & FLAG_POISONED:
+        if log is not None:
+            log.count("sentinel_poisoned")
+        from citizensassemblies_tpu.solvers.sparse_ops import ell_unpack_rows
+
+        G_dense = ell_unpack_rows(ell.idx, ell.val, int(nv))
+        host = _host_resolve_lp(c, G_dense, h, A, b)
+        if host is not None:
+            if log is not None:
+                log.count("sentinel_host_resolve")
+            return host
+    if flags & FLAG_STALLED and log is not None:
+        log.count("sentinel_stalled")
     x = np.asarray(x, dtype=np.float64)
     lam = np.asarray(lam, dtype=np.float64)
     mu = np.asarray(mu, dtype=np.float64)
     res_f = float(res)
     return LPSolution(
-        ok=bool(res_f <= tol * 4.0),
+        ok=bool(res_f <= tol * 4.0) and not (flags & FLAG_POISONED),
         x=x,
         lam=lam,
         mu=mu,

@@ -138,7 +138,8 @@ _L2_FUSED_CORES: LRU = LRU(cap=4, name="l2_fused_cores")
 
 
 def _get_l2_fused_core(
-    eps_iters: int, check_every: int, chunk: int, max_chunks: int
+    eps_iters: int, check_every: int, chunk: int, max_chunks: int,
+    sentinel: bool = False,
 ):
     """Build (once per schedule) the FUSED min-ε + dual-ascent device call.
 
@@ -153,7 +154,10 @@ def _get_l2_fused_core(
     float64 floor/blend arithmetic stays with the caller (soundness
     unchanged).
     """
-    key = (int(eps_iters), int(check_every), int(chunk), int(max_chunks))
+    key = (
+        int(eps_iters), int(check_every), int(chunk), int(max_chunks),
+        bool(sentinel),
+    )
     core = _L2_FUSED_CORES.get(key)
     if core is not None:
         return core
@@ -163,7 +167,7 @@ def _get_l2_fused_core(
 
     from citizensassemblies_tpu.solvers.lp_pdhg import _pdhg_body, _power_norm
 
-    eps_iters, check_every, chunk, max_chunks = key
+    eps_iters, check_every, chunk, max_chunks, sentinel = key
 
     @jax.jit
     def fused(P, t, p_don, eps_margin, eps_tol, ascent_tol):
@@ -177,11 +181,14 @@ def _get_l2_fused_core(
         h = -t
         A = jnp.concatenate([jnp.ones(C, f32), jnp.zeros(1, f32)])[None, :]
         b = jnp.ones(1, f32)
-        x, _lam, _mu, it_eps, _res = _pdhg_body(
+        s1 = _pdhg_body(
             c, G, h, A, b,
             jnp.zeros(C + 1, f32), jnp.zeros(n, f32), jnp.zeros(1, f32),
             eps_tol, max_iters=eps_iters, check_every=check_every,
+            sentinel=sentinel,
         )
+        x, _lam, _mu, it_eps, _res = s1[:5]
+        flags1 = s1[5] if sentinel else None
         q = jnp.clip(x[:C], 0.0, 1.0)
         s = q.sum()
         q_n = jnp.where(s > 0, q / jnp.maximum(s, 1e-30), p_don)
@@ -223,9 +230,27 @@ def _get_l2_fused_core(
 
         lam0 = jnp.zeros(2 * n, f32)
         p0 = p_of(lam0)
-        lam, p, k, _delta = jax.lax.while_loop(
-            cond, block, (lam0, p0, jnp.int32(0), jnp.float32(jnp.inf))
-        )
+        state0 = (lam0, p0, jnp.int32(0), jnp.float32(jnp.inf))
+        if sentinel:
+            # ascent sentinel: a non-finite per-block movement freezes the
+            # carry at the last finite iterate and exits flagged — the
+            # caller re-runs the serial path on a quarantine
+            def s_block(state):
+                inner, flags = state[:4], state[4]
+                new = block(inner)
+                ok = jnp.isfinite(new[3])
+                merged = tuple(jnp.where(ok, a, b) for a, b in zip(new, inner))
+                flags = flags | jnp.where(ok, 0, 1).astype(jnp.int32)
+                return merged + (flags,)
+
+            def s_cond(state):
+                return cond(state[:4]) & (state[4] == 0)
+
+            lam, p, k, _delta, flags3 = jax.lax.while_loop(
+                s_cond, s_block, state0 + (jnp.int32(0),)
+            )
+            return p, p_floor, it_eps, k * chunk, flags1 | flags3
+        lam, p, k, _delta = jax.lax.while_loop(cond, block, state0)
         return p, p_floor, it_eps, k * chunk
 
     _L2_FUSED_CORES[key] = fused
@@ -237,7 +262,8 @@ _L2_FUSED_CORES_ELL: LRU = LRU(cap=4, name="l2_fused_cores_ell")
 
 
 def _get_l2_fused_core_ell(
-    eps_iters: int, check_every: int, chunk: int, max_chunks: int
+    eps_iters: int, check_every: int, chunk: int, max_chunks: int,
+    sentinel: bool = False,
 ):
     """The fused L2 stage on the ELL rep of the portfolio.
 
@@ -250,7 +276,10 @@ def _get_l2_fused_core_ell(
     pair. The float64 floor/blend arithmetic stays with the caller,
     unchanged.
     """
-    key = (int(eps_iters), int(check_every), int(chunk), int(max_chunks))
+    key = (
+        int(eps_iters), int(check_every), int(chunk), int(max_chunks),
+        bool(sentinel),
+    )
     core = _L2_FUSED_CORES_ELL.get(key)
     if core is not None:
         return core
@@ -264,7 +293,7 @@ def _get_l2_fused_core_ell(
         ell_scatter_mv,
     )
 
-    eps_iters, check_every, chunk, max_chunks = key
+    eps_iters, check_every, chunk, max_chunks, sentinel = key
 
     @jax.jit
     def fused(idx, val, t, p_don, eps_margin, eps_tol, ascent_tol):
@@ -273,11 +302,14 @@ def _get_l2_fused_core_ell(
         n = t.shape[0]
         # --- stage 1: min-ε anchor — the two-sided ε master over the
         # portfolio columns, on the packed rep ------------------------------
-        x, _lam, _mu, it_eps, _res = _pdhg_two_sided_body_ell(
+        s1 = _pdhg_two_sided_body_ell(
             idx, val, t, jnp.ones(C, f32),
             jnp.zeros(C + 1, f32), jnp.zeros(2 * n, f32), jnp.zeros((), f32),
             eps_tol, max_iters=eps_iters, check_every=check_every,
+            sentinel=sentinel,
         )
+        x, _lam, _mu, it_eps, _res = s1[:5]
+        flags1 = s1[5] if sentinel else None
         q = jnp.clip(x[:C], 0.0, 1.0)
         s = q.sum()
         q_n = jnp.where(s > 0, q / jnp.maximum(s, 1e-30), p_don)
@@ -321,9 +353,24 @@ def _get_l2_fused_core_ell(
 
         lam0 = jnp.zeros(2 * n, f32)
         p0 = p_of(lam0)
-        lam, p, k, _delta = jax.lax.while_loop(
-            cond, block, (lam0, p0, jnp.int32(0), jnp.float32(jnp.inf))
-        )
+        state0 = (lam0, p0, jnp.int32(0), jnp.float32(jnp.inf))
+        if sentinel:
+            def s_block(state):
+                inner, flags = state[:4], state[4]
+                new = block(inner)
+                ok = jnp.isfinite(new[3])
+                merged = tuple(jnp.where(ok, a, b) for a, b in zip(new, inner))
+                flags = flags | jnp.where(ok, 0, 1).astype(jnp.int32)
+                return merged + (flags,)
+
+            def s_cond(state):
+                return cond(state[:4]) & (state[4] == 0)
+
+            lam, p, k, _delta, flags3 = jax.lax.while_loop(
+                s_cond, s_block, state0 + (jnp.int32(0),)
+            )
+            return p, p_floor, it_eps, k * chunk, flags1 | flags3
+        lam, p, k, _delta = jax.lax.while_loop(cond, block, state0)
         return p, p_floor, it_eps, k * chunk
 
     _L2_FUSED_CORES_ELL[key] = fused
@@ -498,7 +545,9 @@ def solve_final_primal_l2(
             with log.timer("sparse_pack"):
                 ell = EllPack.from_rows(Pnp.astype(np.float32))
             if pack_key is not None:
-                ctx.session.pack_put(pack_key, ell)
+                # attributed write: a failed request's teardown rolls back
+                # exactly the packs it wrote (session rollback ledger)
+                ctx.session.pack_put(pack_key, ell, request_id=ctx.request_id)
         log.gauge("sparse_fill_pct", int(round(100 * ell.fill)))
         log.count("sparse_hit")
     else:
@@ -530,18 +579,32 @@ def solve_final_primal_l2(
                     no_implicit_transfers,
                 )
 
+                from citizensassemblies_tpu.robust import inject
+                from citizensassemblies_tpu.solvers.lp_pdhg import (
+                    FLAG_POISONED,
+                    sentinels_enabled,
+                )
+
+                sent = sentinels_enabled(cfg)
                 chunk = 512
                 max_chunks = max(1, -(-int(iters) // chunk))
                 check_every = int(getattr(cfg, "pdhg_check_every", 128) or 128)
                 with log.timer("l2_fused"):
                     tj = jnp.asarray(target, jnp.float32)
-                    dj = jnp.asarray(p_don, jnp.float32)
+                    dj_h = np.asarray(p_don, np.float32)
+                    if inject.site("qp_nan", log):
+                        # chaos: poison the donor iterate — the QP sentinel
+                        # must quarantine and the serial path must recover
+                        dj_h = dj_h.copy()
+                        dj_h[0] = np.nan
+                    dj = jnp.asarray(dj_h)
                     margin_dev = jnp.asarray(eps_margin, jnp.float32)
                     eps_tol_dev = jnp.asarray(1e-5, jnp.float32)
                     asc_tol_dev = jnp.asarray(1e-7, jnp.float32)
                     if ell is not None:
                         fused_ell = _get_l2_fused_core_ell(
-                            12_288, check_every, chunk, max_chunks
+                            12_288, check_every, chunk, max_chunks,
+                            sentinel=sent,
                         )
                         idx_j = jnp.asarray(ell.idx)
                         val_j = jnp.asarray(ell.val)
@@ -550,14 +613,16 @@ def solve_final_primal_l2(
                             rows=int(P.shape[0]),
                         ) as _ds:
                             with no_implicit_transfers(cfg):
-                                p_dev, pf_dev, _it_eps, _it_asc = fused_ell(
+                                fused_out = fused_ell(
                                     idx_j, val_j, tj, dj,
                                     margin_dev, eps_tol_dev, asc_tol_dev,
                                 )
+                            p_dev, pf_dev = fused_out[0], fused_out[1]
                             _ds.out = (p_dev, pf_dev)
                     else:
                         fused_dense = _get_l2_fused_core(
-                            12_288, check_every, chunk, max_chunks
+                            12_288, check_every, chunk, max_chunks,
+                            sentinel=sent,
                         )
                         Pj = jnp.asarray(P, jnp.float32)
                         with dispatch_span(
@@ -565,16 +630,29 @@ def solve_final_primal_l2(
                             rows=int(P.shape[0]),
                         ) as _ds:
                             with no_implicit_transfers(cfg):
-                                p_dev, pf_dev, _it_eps, _it_asc = fused_dense(
-                                    Pj, tj, dj, margin_dev, eps_tol_dev, asc_tol_dev
+                                fused_out = fused_dense(
+                                    Pj, tj, dj, margin_dev, eps_tol_dev,
+                                    asc_tol_dev,
                                 )
+                            p_dev, pf_dev = fused_out[0], fused_out[1]
                             _ds.out = (p_dev, pf_dev)
                     # host materialization inside the timer (see bench.py:
                     # block_until_ready alone does not drain a TPU tunnel)
                     fused_p = np.asarray(p_dev, dtype=np.float64)
                     p_floor = np.clip(np.asarray(pf_dev, dtype=np.float64), 0.0, 1.0)
                 log.count("lp_batch_l2_fused")
-                sf = p_floor.sum()
+                fused_flags = int(np.asarray(fused_out[4])) if sent else 0
+                if (fused_flags & FLAG_POISONED) or not np.all(
+                    np.isfinite(fused_p)
+                ):
+                    # quarantine: discard the fused iterates entirely — the
+                    # serial ascent below re-runs from the clean donor and
+                    # the float64 floor/blend arithmetic judges it as always
+                    log.count("sentinel_quarantined")
+                    log.count("sentinel_host_resolve")
+                    fused_p = None
+                    p_floor = None
+                sf = p_floor.sum() if p_floor is not None else np.nan
                 if np.isfinite(sf) and sf > 0:
                     p_floor = p_floor / sf
                     # the ε floor the blend trusts is recomputed in float64

@@ -300,6 +300,57 @@ class Config:
     #: reported on the request audit stamp.
     serve_tenant_memo_cap: int = 8
 
+    # --- fault tolerance (citizensassemblies_tpu/robust) -----------------------
+    #: chaos-run fault-injection spec: ``"site:rate,site:rate"`` over the
+    #: sites catalogued in ``robust/inject.FAULT_SITES`` (and the README).
+    #: Empty (the default) disables injection entirely — the hot-boundary
+    #: consults reduce to a None check. Firing is seed-deterministic
+    #: (``fault_seed``): the same spec + seed reproduces the identical
+    #: fault schedule, so every chaos finding replays.
+    fault_sites: str = ""
+    #: seed of the deterministic fault schedule (crc-based, process-stable).
+    fault_seed: int = 0
+    #: numerical sentinels inside the jitted PDHG/QP ``while_loop`` carries:
+    #: a lane whose KKT residual goes non-finite is FROZEN at its last
+    #: finite iterate and flagged (per-lane quarantine masks, the same
+    #: select pattern as the batched engine's convergence masks) instead of
+    #: propagating NaN; quarantined lanes are re-solved on the serial
+    #: float64 host path. Zero-fault runs are bit-identical with the
+    #: sentinel on or off (pinned by test), and the static flag adds no
+    #: recompiles or steady-state host syncs. False = the exact pre-sentinel
+    #: jaxpr.
+    robust_sentinels: bool = True
+    #: snapshot the face-decomposition loop's certified state (portfolio
+    #: columns, mixture, arithmetic ε) every N rounds so a killed/aborted
+    #: request resumes from its last certified round instead of restarting
+    #: (``robust/checkpoint.py``, atomic tmp+rename writes). 0 (default)
+    #: disables face checkpointing.
+    robust_checkpoint_every: int = 0
+    #: directory for face-loop checkpoints (``face_<fp16>.npz``, content-
+    #: fingerprinted so a snapshot only resumes into the identical
+    #: problem). Empty disables face checkpointing.
+    robust_checkpoint_dir: str = ""
+    #: per-request wall-clock deadline (seconds), threaded through
+    #: ``RequestContext`` and checked once per CG round at the round's
+    #: existing host sync point. Expiry raises a graceful
+    #: ``DeadlineExceeded`` rejection carrying a partial audit stamp
+    #: instead of hanging. 0 (default) disables the deadline.
+    serve_deadline_s: float = 0.0
+    #: transient-fault retries per request (injected faults and real
+    #: backend failures): each retry backs off exponentially from
+    #: ``serve_retry_backoff_s`` and walks one rung down the certified
+    #: degradation ladder (device pricing → host MILP, ELL → dense,
+    #: batched → serial, fused screen → host screen).
+    serve_retry_max: int = 2
+    #: base backoff (seconds) of the exponential retry delay.
+    serve_retry_backoff_s: float = 0.05
+    #: cap on retained ResultChannel events per request: past it, incoming
+    #: progress/metrics events are dropped AND counted
+    #: (``ResultChannel.dropped``) — the terminal result + audit stamp is
+    #: always retained, so a long-running request's stream cannot grow
+    #: without bound.
+    serve_channel_cap: int = 1024
+
     # --- observability (citizensassemblies_tpu/obs) ----------------------------
     #: grafttrace span tracing, tri-state. ``False`` = hard off: the span
     #: helpers and ``dispatch_span`` hooks are inert even with a tracer

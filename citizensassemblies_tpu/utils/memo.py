@@ -118,6 +118,12 @@ class LRU:
     def __iter__(self) -> Iterator:
         return iter(list(self._d))
 
+    def pop(self, key, default: Optional[Any] = None):
+        """Remove and return one entry WITHOUT counting an eviction — a
+        deliberate removal (request-state rollback) is not cache pressure."""
+        self._owners.pop(key, None)
+        return self._d.pop(key, default)
+
     def clear(self) -> None:
         self._d.clear()
         self._owners.clear()

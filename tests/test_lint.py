@@ -530,3 +530,68 @@ def test_cli_exit_codes(tmp_path):
     )
     assert lint_main([str(bad)]) == 1
     assert lint_main([str(REPO_ROOT / "citizensassemblies_tpu")]) == 0
+
+
+# --- R9: fault-site catalogue ------------------------------------------------
+
+_R9_REGISTRY = (
+    "FAULT_SITES = {'alpha': 'poisons a lane', 'beta': 'raises'}\n"
+)
+_R9_README = "## Fault tolerance\n\n| Site |\n|---|\n| `alpha` |\n| `beta` |\n"
+
+
+def test_r9_documented_registered_literal_clean(tmp_path):
+    report = _lint(tmp_path, {
+        "robust/inject.py": _R9_REGISTRY,
+        "mod.py": (
+            "from citizensassemblies_tpu.robust import inject\n"
+            "def f(log):\n"
+            "    if inject.site('alpha', log):\n"
+            "        pass\n"
+            "    inject.raise_if('beta', log)\n"
+        ),
+    }, readme=_R9_README)
+    assert "R9" not in _rules(report), render_report(report)
+
+
+def test_r9_unregistered_site_flagged(tmp_path):
+    report = _lint(tmp_path, {
+        "robust/inject.py": _R9_REGISTRY,
+        "mod.py": (
+            "from citizensassemblies_tpu.robust import inject\n"
+            "def f(log):\n"
+            "    inject.site('gamma', log)\n"
+        ),
+    }, readme=_R9_README)
+    viols = [v for v in report.violations if v.rule == "R9"]
+    assert viols, render_report(report)
+    assert "not registered" in viols[0].message
+
+
+def test_r9_undocumented_site_flagged(tmp_path):
+    registry = "FAULT_SITES = {'alpha': 'x', 'hidden': 'y'}\n"
+    report = _lint(tmp_path, {
+        "robust/inject.py": registry,
+        "mod.py": (
+            "from citizensassemblies_tpu.robust import inject\n"
+            "def f(log):\n"
+            "    inject.site('hidden', log)\n"
+        ),
+    }, readme=_R9_README)
+    viols = [v for v in report.violations if v.rule == "R9"]
+    assert viols, render_report(report)
+    assert "catalogue" in viols[0].message
+
+
+def test_r9_non_literal_site_flagged(tmp_path):
+    report = _lint(tmp_path, {
+        "robust/inject.py": _R9_REGISTRY,
+        "mod.py": (
+            "from citizensassemblies_tpu.robust import inject\n"
+            "def f(name, log):\n"
+            "    inject.site(name, log)\n"
+        ),
+    }, readme=_R9_README)
+    viols = [v for v in report.violations if v.rule == "R9"]
+    assert viols, render_report(report)
+    assert "LITERAL" in viols[0].message
