@@ -20,7 +20,14 @@ run for real and fail the process, so a dispatch-count or compile-bound
 regression fails CI rather than waiting for the offline bench. The smoke
 also runs a TRACED face decomposition (grafttrace sampling mode), asserts
 its Chrome-trace artifact validates and covers ≥ 90 % of the phase, and
-writes ``trace_smoke.json`` + ``metrics_smoke.prom`` for the CI upload.
+writes ``artifacts/trace_smoke.json`` + ``artifacts/metrics_smoke.prom``
+for the CI upload (every smoke output lands in the gitignored
+``artifacts/`` directory).
+
+``python bench.py --scenarios`` runs the graftscenario rows (dropout-robust
+leximin vs the naive re-draw baseline on MC realized-min, R-round
+multi-assembly scheduling with the pair-equity gauge);
+``--scenarios --smoke`` is the CI variant.
 
 ``python bench.py --trend`` is the regression gate over the committed
 BENCH_*.json / BENCH_serve_*.json trajectory (``obs/trend.py``): per-row
@@ -33,6 +40,16 @@ import json
 import os
 import sys
 import time
+
+
+def _artifacts_dir() -> str:
+    """Gitignored ``artifacts/`` directory next to this file — every smoke
+    output (traces, Prometheus dumps, chaos/scenario reports) lands here so
+    the repo root stays clean and the CI upload globs one directory."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(root, "artifacts")
+    os.makedirs(path, exist_ok=True)
+    return path
 
 
 def _example_large_like():
@@ -1005,16 +1022,15 @@ def smoke() -> int:
             f"trace spans cover {coverage:.1%} of the face-decomposition "
             "phase (< 90%)"
         )
-    root = os.path.dirname(os.path.abspath(__file__))
     trace_path = os.environ.get(
-        "BENCH_TRACE_PATH", os.path.join(root, "trace_smoke.json")
+        "BENCH_TRACE_PATH", os.path.join(_artifacts_dir(), "trace_smoke.json")
     )
     trace_doc = export_chrome_trace([obs_tracer], path=trace_path)
     schema_problems = validate_chrome_trace(trace_doc)
     if schema_problems:
         failures.append(f"trace schema invalid: {schema_problems[:3]}")
     metrics_path = os.environ.get(
-        "BENCH_METRICS_PATH", os.path.join(root, "metrics_smoke.prom")
+        "BENCH_METRICS_PATH", os.path.join(_artifacts_dir(), "metrics_smoke.prom")
     )
     try:
         with open(metrics_path, "w", encoding="utf-8") as fh:
@@ -1200,17 +1216,17 @@ def serve_bench(smoke_mode: bool = False) -> int:
     # --- grafttrace artifacts: merged per-request trace + Prometheus dump --
     from citizensassemblies_tpu.obs import validate_chrome_trace
 
-    root_dir = os.path.dirname(os.path.abspath(__file__))
+    art_dir = _artifacts_dir()
     serve_trace_path = os.environ.get(
-        "BENCH_SERVE_TRACE_PATH", os.path.join(root_dir, "trace_serve_smoke.json")
-    ) if smoke_mode else os.path.join(root_dir, "trace_serve.json")
+        "BENCH_SERVE_TRACE_PATH", os.path.join(art_dir, "trace_serve_smoke.json")
+    ) if smoke_mode else os.path.join(art_dir, "trace_serve.json")
     serve_doc = svc.export_traces(path=serve_trace_path)
     serve_schema_problems = validate_chrome_trace(serve_doc)
     if serve_schema_problems:
         failures.append(f"serve trace schema invalid: {serve_schema_problems[:3]}")
     prom_text = svc.metrics_text()
     serve_metrics_path = os.path.join(
-        root_dir, "metrics_serve_smoke.prom" if smoke_mode else "metrics_serve.prom"
+        art_dir, "metrics_serve_smoke.prom" if smoke_mode else "metrics_serve.prom"
     )
     try:
         with open(serve_metrics_path, "w", encoding="utf-8") as fh:
@@ -1295,6 +1311,170 @@ def serve_bench(smoke_mode: bool = False) -> int:
             "failures": failures,
         }
     print(json.dumps(row))
+    return 1 if failures else 0
+
+
+def scenario_bench(smoke_mode: bool = False) -> int:
+    """graftscenario bench (``--scenarios``): one row per scenario model.
+
+    * ``scenario_dropout``: solve the SAME heterogeneous-dropout instance
+      attendance-aware (``find_distribution_dropout``, "type" replacement)
+      and attendance-blind (plain leximin, "naive" re-draw replacement),
+      then evaluate BOTH portfolios with the MC dropout-realization kernel
+      on the same key stream. The acceptance assertion: the aware portfolio
+      beats the naive re-draw baseline on realized-min selection probability
+      (minimum covered-agent frequency of a seat on a quota-VALID realized
+      panel), with the MC stamp recorded on the row.
+    * ``scenario_multi``: R-round multi-assembly scheduling — asserts the
+      1e-3 L∞ aggregate contract, zero repeats on drawn schedules, and
+      records the pair-equity gauge (max co-selection probability vs the
+      uniform pair value).
+
+    ``--scenarios --smoke`` is the CI variant (tiny instances, fewer MC
+    draws). Writes the full row set to ``artifacts/SCENARIO_report.json``.
+    """
+    import numpy as np
+
+    from citizensassemblies_tpu.core.generator import random_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+    from citizensassemblies_tpu.scenarios import (
+        find_distribution_dropout,
+        find_distribution_multi,
+    )
+    from citizensassemblies_tpu.scenarios.dropout import evaluate_realization
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+    from citizensassemblies_tpu.utils.config import default_config
+    from citizensassemblies_tpu.utils.logging import RunLog
+
+    t_start = time.time()
+    failures = []
+    draws = 8_192 if smoke_mode else int(os.environ.get("BENCH_MC_DRAWS", "65536"))
+    if smoke_mode:
+        n, k, n_categories = 24, 5, 2
+    else:
+        n, k, n_categories = 60, 8, 2
+    cfg = default_config().replace(scenario_mc_draws=draws)
+
+    # --- dropout row: aware "type" policy vs blind naive re-draw -----------
+    dense, space = featurize(
+        random_instance(n=n, k=k, n_categories=n_categories, seed=0)
+    )
+    drop = np.random.default_rng(0).uniform(0.0, 0.5, size=dense.n)
+    t0 = time.time()
+    log = RunLog(echo=False)
+    aware = find_distribution_dropout(dense, space, dropout=drop, cfg=cfg, log=log)
+    dropout_s = time.time() - t0
+    if not aware.contract_ok:
+        failures.append(
+            f"dropout portfolio broke the 1e-3 contract "
+            f"(dev {aware.realization_dev:.2e})"
+        )
+    blind = find_distribution_leximin(dense, space, cfg=cfg)
+
+    class _Blind:
+        """The naive re-draw baseline the acceptance row compares against:
+        the attendance-blind leximin portfolio, realized under the "naive"
+        policy (re-draw replacements uniformly from ALL off-panel agents)."""
+
+        committees = blind.committees
+        probabilities = blind.probabilities
+        attendance = aware.attendance
+        type_id = TypeReduction(dense).type_id
+        covered = blind.covered
+
+    ours_mc = evaluate_realization(
+        aware, dense, cfg=cfg, draws=draws, policy="type", seed=0
+    )
+    naive_mc = evaluate_realization(
+        _Blind(), dense, cfg=cfg, draws=draws, policy="naive", seed=0
+    )
+    if not ours_mc["realized_min"] > naive_mc["realized_min"]:
+        failures.append(
+            f"dropout-aware portfolio did not beat the naive re-draw "
+            f"baseline on realized-min ({ours_mc['realized_min']:.4f} vs "
+            f"{naive_mc['realized_min']:.4f})"
+        )
+    dropout_row = {
+        "metric": "scenario_dropout",
+        "value": round(ours_mc["realized_min"], 6),
+        "unit": "realized_min_prob",
+        "detail": {
+            "n": dense.n,
+            "k": dense.k,
+            "seconds": round(dropout_s, 2),
+            "buckets": aware.scenario_audit.get("buckets"),
+            "product_types": aware.scenario_audit.get("types"),
+            "fallback": aware.scenario_audit.get("fallback"),
+            "certified_min_realized": aware.scenario_audit.get(
+                "certified_min_realized"
+            ),
+            "realization_dev": round(aware.realization_dev, 9),
+            "mc_aware_type": ours_mc,
+            "mc_blind_naive": naive_mc,
+            "beats_naive_redraw": ours_mc["realized_min"]
+            > naive_mc["realized_min"],
+        },
+    }
+
+    # --- multi row: R-round scheduling + pair-equity gauge -----------------
+    # lp_batch=True so the row exercises the R-fold fleet through the
+    # batched engine (the host per-round path is the gate-off fallback)
+    R = 3
+    t0 = time.time()
+    multi = find_distribution_multi(
+        dense, space, rounds=R, cfg=cfg.replace(lp_batch=True)
+    )
+    multi_s = time.time() - t0
+    if not multi.contract_ok:
+        failures.append(
+            f"multi aggregate allocation broke the 1e-3 contract "
+            f"(dev {multi.realization_dev:.2e})"
+        )
+    repeat_free = True
+    for seed in range(4):
+        sched = multi.realize(seed=seed)
+        if len(np.unique(sched.ravel())) != R * dense.k:
+            repeat_free = False
+            failures.append(f"multi schedule (seed {seed}) seats an agent twice")
+    multi_row = {
+        "metric": "scenario_multi",
+        "value": round(multi.pair_ratio, 4),
+        "unit": "pair_ratio_vs_uniform",
+        "detail": {
+            "n": dense.n,
+            "k": dense.k,
+            "rounds": R,
+            "seconds": round(multi_s, 2),
+            "fleet_backend": multi.scenario_audit.get("fleet_backend"),
+            "round_eps_max": multi.scenario_audit.get("round_eps_max"),
+            "pair_max": round(multi.pair_max, 6),
+            "pair_uniform": round(multi.pair_uniform, 6),
+            "certified_min_aggregate": multi.scenario_audit.get(
+                "certified_min_aggregate"
+            ),
+            "realization_dev": round(multi.realization_dev, 9),
+            "zero_repeats": repeat_free,
+        },
+    }
+
+    report = {
+        "scenario_ok": not failures,
+        "seconds": round(time.time() - t_start, 1),
+        "mc_draws": draws,
+        "rows": [dropout_row, multi_row],
+        "failures": failures,
+    }
+    out_path = os.environ.get(
+        "BENCH_SCENARIO_REPORT",
+        os.path.join(_artifacts_dir(), "SCENARIO_report.json"),
+    )
+    try:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(report))
     return 1 if failures else 0
 
 
@@ -1466,7 +1646,7 @@ def chaos_bench(smoke_mode: bool = False) -> int:
             "fired": stats["fired"],
             "counters": {
                 k: v for k, v in sorted(olog.counters.items())
-                if k.startswith(("sentinel_", "robust_", "fault_"))
+                if k.startswith(("sentinel_", "robust_", "fault_", "deadline_"))
             },
             "ok": ok,
             "note": note,
@@ -1607,6 +1787,67 @@ def chaos_bench(smoke_mode: bool = False) -> int:
 
     offline_pass("qp_donor", "qp_nan:1.0", 17, qp_pass)
 
+    # (d) scenario entry point under an EXPIRED deadline: the dropout model
+    # must reject gracefully (DeadlineExceeded with the trip counted), not
+    # hang or return an uncertified portfolio
+    def scenario_deadline_pass(olog):
+        from citizensassemblies_tpu.core.instance import featurize as _feat
+        from citizensassemblies_tpu.robust.policy import Deadline, DeadlineExceeded
+        from citizensassemblies_tpu.scenarios import find_distribution_dropout
+        from citizensassemblies_tpu.service.context import RequestContext
+
+        dense, space = _feat(
+            random_instance(n=24, k=5, n_categories=2, seed=0)
+        )
+        drop = np.random.default_rng(0).uniform(0.0, 0.5, size=dense.n)
+        dctx = RequestContext.create(
+            cfg=default_config(), log=olog, deadline=Deadline(0.0)
+        )
+        try:
+            find_distribution_dropout(
+                dense, space, dropout=drop, log=olog, ctx=dctx
+            )
+        except DeadlineExceeded as exc:
+            if not olog.counters.get("deadline_exceeded", 0):
+                return False, "rejection raised but the trip was not counted"
+            return True, f"graceful rejection: {str(exc)[:80]}"
+        return False, "expired deadline was ignored by the dropout model"
+
+    offline_pass("scenario_deadline", "", 0, scenario_deadline_pass)
+
+    # (e) the multi-assembly R-fold fleet under lane NaN poisoning: the
+    # batched-LP sentinel must quarantine + host re-solve, and the schedule
+    # must still come out contract-clean with zero repeats
+    def scenario_fleet_pass(olog):
+        from citizensassemblies_tpu.core.instance import featurize as _feat
+        from citizensassemblies_tpu.scenarios import find_distribution_multi
+
+        dense, space = _feat(
+            random_instance(n=24, k=5, n_categories=2, seed=0)
+        )
+        mcfg = default_config().replace(lp_batch=True, scenario_rounds=2)
+        multi = find_distribution_multi(dense, space, rounds=2, cfg=mcfg, log=olog)
+        if not multi.contract_ok or multi.realization_dev > 1e-3:
+            return False, (
+                f"poisoned fleet broke the contract "
+                f"(dev {multi.realization_dev:.2e})"
+            )
+        sched = multi.realize(seed=0)
+        if len(np.unique(sched.ravel())) != 2 * dense.k:
+            return False, "poisoned fleet produced a schedule with repeats"
+        if not (
+            olog.counters.get("sentinel_quarantined", 0)
+            or olog.counters.get("sentinel_host_resolve", 0)
+            or olog.counters.get("robust_host_resolve", 0)
+        ):
+            return False, "pdhg_nan fired but no sentinel recovery registered"
+        return True, (
+            f"dev {multi.realization_dev:.2e}, "
+            f"backend {multi.scenario_audit.get('fleet_backend')}"
+        )
+
+    offline_pass("scenario_fleet_sentinel", "pdhg_nan:1.0", 21, scenario_fleet_pass)
+
     report = {
         "chaos_ok": not failures,
         "seconds": round(time.time() - t_start, 1),
@@ -1630,9 +1871,8 @@ def chaos_bench(smoke_mode: bool = False) -> int:
         "errors": errors,
         "failures": failures,
     }
-    root_dir = os.path.dirname(os.path.abspath(__file__))
     out_path = os.environ.get(
-        "BENCH_CHAOS_REPORT", os.path.join(root_dir, "CHAOS_report.json")
+        "BENCH_CHAOS_REPORT", os.path.join(_artifacts_dir(), "CHAOS_report.json")
     )
     try:
         with open(out_path, "w", encoding="utf-8") as fh:
@@ -1668,6 +1908,8 @@ if __name__ == "__main__":
         raise SystemExit(trend())
     if "--chaos" in sys.argv:
         raise SystemExit(chaos_bench(smoke_mode="--smoke" in sys.argv))
+    if "--scenarios" in sys.argv:
+        raise SystemExit(scenario_bench(smoke_mode="--smoke" in sys.argv))
     if "--serve" in sys.argv:
         raise SystemExit(serve_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:

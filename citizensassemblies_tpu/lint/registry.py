@@ -89,6 +89,7 @@ MANIFEST: Tuple[str, ...] = (
     "citizensassemblies_tpu.kernels.ell_matvec",
     "citizensassemblies_tpu.kernels.sampler",
     "citizensassemblies_tpu.models.legacy",
+    "citizensassemblies_tpu.parallel.mc",
     "citizensassemblies_tpu.parallel.solver",
     "citizensassemblies_tpu.parallel.sweep",
     "citizensassemblies_tpu.solvers.batch_lp",

@@ -4,3 +4,9 @@ from citizensassemblies_tpu.models.legacy import (  # noqa: F401
     sample_feasible_panels,
     sample_panels_batch,
 )
+from citizensassemblies_tpu.scenarios import (  # noqa: F401
+    DropoutDistribution,
+    MultiAssemblyResult,
+    find_distribution_dropout,
+    find_distribution_multi,
+)

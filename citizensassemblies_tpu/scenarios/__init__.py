@@ -1,0 +1,52 @@
+"""graftscenario: deployment-shaped selection models over the CG machinery.
+
+The core pipeline selects ONE panel from a static pool that always shows up.
+Real deployments face two departures from that model — no-shows and repeated
+assemblies — and both collapse onto the same certified type-space engine
+(``solvers/compositions.py``) through a *product type-space* construction:
+
+* **Dropout-robust leximin** (:mod:`~citizensassemblies_tpu.scenarios.dropout`)
+  quantizes per-agent attendance probabilities into buckets, augments the
+  instance with a vacuous-quota bucket category (so agents of one base type
+  but different attendance become distinct product types), and runs the
+  ordinary composition leximin with an attendance-weighted divisor — the
+  certified ``type_values`` are then *realized* (post-dropout) selection
+  probabilities, not paper probabilities. A vmapped/chain-sharded realization
+  kernel (``parallel/mc.py::dropout_realization_round``) evaluates the
+  distribution under a replacement policy against the naive re-draw baseline.
+
+* **Multi-assembly scheduling** (:mod:`~citizensassemblies_tpu.scenarios.multi`)
+  runs leximin over R successive panels with a no-agent-seated-twice
+  constraint: enumeration is capped at ``⌊m_t/R⌋`` seats per type per round —
+  which makes ANY drawn R-round schedule disjoint-realizable — and the
+  aggregate (≥1-round) selection probabilities are certified by the same
+  composition leximin with an ``m/R`` divisor. The R per-round probability
+  recoveries compile into one R-fold LP fleet through ``solvers/batch_lp.py``
+  (cross-fleet bucketing: R same-shape lanes, one dispatch), and pair-level
+  equity is gauged against the uniform pair value à la XMIN (``ops/pairs.py``).
+
+Both models register as first-class ``algorithm`` values in the service layer
+and carry a ``scenario_audit`` stamp into the per-request audit record.
+"""
+
+from __future__ import annotations
+
+
+class ScenarioError(RuntimeError):
+    """A scenario model cannot run on this instance as configured."""
+
+
+class SchedulingInfeasible(ScenarioError):
+    """No feasible R-round disjoint schedule exists: the per-round type caps
+    ``⌊m_t/R⌋`` leave the quotas unsatisfiable. Lower ``rounds`` or relax
+    the quotas."""
+
+
+from citizensassemblies_tpu.scenarios.dropout import (  # noqa: E402,F401
+    DropoutDistribution,
+    find_distribution_dropout,
+)
+from citizensassemblies_tpu.scenarios.multi import (  # noqa: E402,F401
+    MultiAssemblyResult,
+    find_distribution_multi,
+)

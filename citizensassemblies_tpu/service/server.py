@@ -66,10 +66,12 @@ class SelectionRequest:
     overrides the service's default config FOR THIS REQUEST only (the
     re-entrancy refactor exists so that this is safe). ``iterations``/
     ``seed`` parameterize the LEGACY Monte-Carlo estimator and are ignored
-    by the exact algorithms.
+    by the exact algorithms. ``dropout`` (per-agent no-show probabilities)
+    parameterizes the "dropout" scenario algorithm; ``rounds`` the "multi"
+    scenario (``None`` → ``Config.scenario_rounds``).
     """
 
-    algorithm: str = "leximin"  # "legacy" | "leximin" | "xmin"
+    algorithm: str = "leximin"  # "legacy" | "leximin" | "xmin" | "dropout" | "multi"
     instance: Any = None
     dense: Any = None
     space: Any = None
@@ -79,6 +81,8 @@ class SelectionRequest:
     request_id: Optional[str] = None
     iterations: int = 1_000
     seed: int = 0
+    dropout: Optional[np.ndarray] = None
+    rounds: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -635,6 +639,20 @@ class SelectionService:
         fp = problem_fingerprint(dense, cfg, request.households)
         if request.algorithm == "legacy":
             fp = f"{fp}:{request.iterations}:{request.seed}"
+        elif request.algorithm == "dropout":
+            # the no-show vector is part of the problem identity: two
+            # requests on the same instance with different dropout profiles
+            # must not share a memo slot
+            import zlib
+
+            d = np.ascontiguousarray(
+                np.asarray(request.dropout, dtype=np.float64)
+                if request.dropout is not None
+                else np.zeros(0)
+            )
+            fp = f"{fp}:drop{zlib.crc32(d.tobytes()) & 0xFFFFFFFF:08x}"
+        elif request.algorithm == "multi":
+            fp = f"{fp}:R{request.rounds if request.rounds is not None else cfg.scenario_rounds}"
         return fp
 
     def _execute(self, request: SelectionRequest, dense, space, ctx, fp: str):
@@ -679,7 +697,28 @@ class SelectionService:
                 dense, space, cfg=ctx.cfg, households=request.households,
                 log=ctx.log, leximin=seed_dist,
             )
-        raise ValueError(f"unknown algorithm {algo!r} (legacy|leximin|xmin)")
+        if algo == "dropout":
+            from citizensassemblies_tpu.scenarios import find_distribution_dropout
+
+            if request.dropout is None:
+                raise ValueError(
+                    "algorithm 'dropout' requires request.dropout "
+                    "(per-agent no-show probabilities)"
+                )
+            return find_distribution_dropout(
+                dense, space, dropout=request.dropout, cfg=ctx.cfg,
+                households=request.households, log=ctx.log,
+            )
+        if algo == "multi":
+            from citizensassemblies_tpu.scenarios import find_distribution_multi
+
+            return find_distribution_multi(
+                dense, space, rounds=request.rounds, cfg=ctx.cfg,
+                households=request.households, log=ctx.log,
+            )
+        raise ValueError(
+            f"unknown algorithm {algo!r} (legacy|leximin|xmin|dropout|multi)"
+        )
 
     def _finish(
         self,
@@ -719,6 +758,10 @@ class SelectionService:
             audit["contract_ok"] = bool(result.contract_ok)
         if hasattr(result, "draws_attempted"):
             audit["draws_attempted"] = int(result.draws_attempted)
+        # scenario models (scenarios/) carry their own audit block — bucket
+        # counts, fallback reasons, MC realization stamps, pair gauges
+        if hasattr(result, "scenario_audit"):
+            audit["scenario"] = dict(result.scenario_audit)
         if ctx.session is not None:
             audit["session"] = ctx.session.stats()
             audit["tenant_memo_evictions"] = memo_evictions_by_owner().get(

@@ -300,6 +300,29 @@ class Config:
     #: reported on the request audit stamp.
     serve_tenant_memo_cap: int = 8
 
+    # --- scenario models (citizensassemblies_tpu/scenarios) --------------------
+    #: attendance buckets for the dropout-robust leximin: per-agent no-show
+    #: probabilities are quantized into this many equal-width buckets, and
+    #: the bucket becomes an extra (vacuous-quota) feature category, so the
+    #: product type-space stays enumerable. More buckets = finer attendance
+    #: resolution but multiplies the type count (enum_max_types gates the
+    #: product; past it the model degrades to attendance-unaware leximin,
+    #: stamped on the scenario audit).
+    scenario_dropout_buckets: int = 4
+    #: replacement policy for realized dropout evaluation: "type" fills each
+    #: no-show seat with a uniformly random off-panel agent of the SAME
+    #: base type (quota-preserving by construction — the replacement's
+    #: feature row equals the no-show's), "naive" re-draws uniformly from
+    #: ALL off-panel agents (the baseline policy; may violate quotas),
+    #: "none" leaves no-show seats empty.
+    scenario_replacement: str = "type"
+    #: default number of successive panels R for multi-assembly scheduling
+    #: (``scenarios/multi.py``) when the caller does not pass ``rounds``.
+    scenario_rounds: int = 3
+    #: Monte-Carlo draws for the dropout-realization evaluation kernel
+    #: (``parallel/mc.py::dropout_realization_round``).
+    scenario_mc_draws: int = 4_096
+
     # --- fault tolerance (citizensassemblies_tpu/robust) -----------------------
     #: chaos-run fault-injection spec: ``"site:rate,site:rate"`` over the
     #: sites catalogued in ``robust/inject.FAULT_SITES`` (and the README).

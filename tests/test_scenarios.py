@@ -1,0 +1,330 @@
+"""graftscenario tests: dropout-robust leximin, multi-assembly scheduling,
+the dropout-realization MC kernel, and the service/scenario integration."""
+
+import jax
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.core.generator import random_instance
+from citizensassemblies_tpu.core.instance import featurize
+from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+from citizensassemblies_tpu.parallel.mc import dropout_realization_round
+from citizensassemblies_tpu.parallel.mesh import make_mesh
+from citizensassemblies_tpu.scenarios import (
+    ScenarioError,
+    SchedulingInfeasible,
+    find_distribution_dropout,
+    find_distribution_multi,
+)
+from citizensassemblies_tpu.scenarios.dropout import evaluate_realization
+from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+from citizensassemblies_tpu.utils.config import default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+
+def _tiny(seed=0, n=24, k=5, n_categories=2):
+    return featurize(random_instance(n=n, k=k, n_categories=n_categories, seed=seed))
+
+
+def _hetero_dropout(n, seed=0, lo=0.0, hi=0.5):
+    return np.random.default_rng(seed).uniform(lo, hi, size=n)
+
+
+# --- dropout-robust leximin ---------------------------------------------------
+
+
+def test_dropout_contract_and_certified_improvement():
+    """The dropout model's certified realized-min dominates the
+    attendance-blind leximin's realized-min, and the portfolio realizes the
+    selection targets within the 1e-3 contract."""
+    dense, space = _tiny(seed=0)
+    drop = _hetero_dropout(dense.n, seed=0)
+    w = 1.0 - np.clip(drop, 0.0, 0.95)
+
+    d = find_distribution_dropout(dense, space, dropout=drop)
+    assert d.contract_ok and d.realization_dev <= 1e-3
+    assert "fallback" not in d.scenario_audit
+    # exact identity: certified realized values are the attendance-weighted
+    # selection targets (up to the bucket-representative quantization)
+    assert d.realized_values.shape == (dense.n,)
+
+    plain = find_distribution_leximin(dense, space)
+    blind_min = float((w * plain.allocation)[plain.covered].min())
+    aware_min = float(d.realized_values[d.covered].min())
+    # the dropout objective leximin-maximizes exactly this quantity, so it
+    # can only improve on the attendance-blind portfolio (quantization slack
+    # is bounded by the audit's recorded L∞ error)
+    slack = d.scenario_audit["quantization_linf"] + 1e-6
+    assert aware_min >= blind_min - slack
+    assert aware_min > blind_min  # strict on this heterogeneous instance
+
+
+def test_dropout_mc_stamp_and_audit():
+    dense, space = _tiny(seed=1)
+    cfg = default_config().replace(scenario_mc_draws=512)
+    d = find_distribution_dropout(dense, space, dropout=_hetero_dropout(dense.n, 1), cfg=cfg)
+    mc = d.scenario_audit["mc"]
+    assert mc["policy"] == "type"
+    assert mc["draws"] == 512
+    assert 0.0 <= mc["realized_min"] <= 1.0
+    assert 0.0 < mc["quota_ok_rate"] <= 1.0
+
+
+def test_dropout_fallback_when_product_space_too_large():
+    dense, space = _tiny(seed=2)
+    cfg = default_config().replace(enum_max_types=2, scenario_mc_draws=0)
+    d = find_distribution_dropout(dense, space, dropout=_hetero_dropout(dense.n, 2), cfg=cfg)
+    assert "fallback" in d.scenario_audit
+    assert d.contract_ok  # the selection-space certificate still holds
+
+
+def test_dropout_requires_dropout_and_rejects_households():
+    dense, space = _tiny(seed=0)
+    with pytest.raises(ScenarioError):
+        find_distribution_dropout(dense, space, dropout=None)
+    with pytest.raises(ScenarioError):
+        find_distribution_dropout(
+            dense, space, dropout=np.zeros(dense.n),
+            households=np.zeros(dense.n, dtype=np.int64),
+        )
+
+
+# --- dropout-realization MC kernel -------------------------------------------
+
+
+def _exact_realization(P, probs, w, type_id, policy):
+    """Exact expected seating frequency by enumerating the 2^k attendance
+    patterns of every support panel (the small-case oracle of the MC
+    kernel's acceptance test)."""
+    import itertools
+
+    n = P.shape[1]
+    freq = np.zeros(n)
+    for row, pc in zip(P, probs):
+        S = np.nonzero(row)[0]
+        off = np.nonzero(~row)[0]
+        for pattern in itertools.product([0, 1], repeat=len(S)):
+            pa = 1.0
+            shows = []
+            noshows = []
+            for i, bit in zip(S, pattern):
+                if bit:
+                    pa *= w[i]
+                    shows.append(i)
+                else:
+                    pa *= 1.0 - w[i]
+                    noshows.append(i)
+            contrib = np.zeros(n)
+            contrib[shows] = 1.0
+            if policy == "type" and noshows:
+                for t in set(type_id[noshows].tolist()):
+                    need = sum(1 for i in noshows if type_id[i] == t)
+                    cand = off[type_id[off] == t]
+                    if len(cand):
+                        contrib[cand] += min(need, len(cand)) / len(cand)
+            elif policy == "naive" and noshows:
+                contrib[off] += min(len(noshows), len(off)) / len(off)
+            freq += pc * pa * contrib
+    return freq
+
+
+@pytest.mark.parametrize("policy", ["none", "type", "naive"])
+def test_dropout_mc_matches_exact_enumeration(policy):
+    """Satellite: the realized-attendance distribution of the MC kernel
+    matches an exact small-case enumeration for every replacement policy."""
+    dense, _ = _tiny(seed=3, n=18, k=4)
+    red = TypeReduction(dense)
+    P = np.zeros((3, dense.n), dtype=bool)
+    P[0, [0, 1, 2, 3]] = True
+    P[1, [4, 5, 6, 7]] = True
+    P[2, [2, 5, 9, 12]] = True
+    probs = np.array([0.5, 0.3, 0.2])
+    w = np.linspace(0.45, 0.95, dense.n)
+    draws = 60_000
+    real = dropout_realization_round(
+        P, probs, w, red.type_id, dense, jax.random.PRNGKey(11), draws, policy=policy
+    )
+    exact = _exact_realization(P, probs, w, red.type_id, policy)
+    # 4σ of the per-agent binomial noise at p=0.5
+    tol = 4.0 * 0.5 / np.sqrt(draws)
+    assert np.abs(real.frequencies - exact).max() < tol
+
+
+@pytest.mark.parametrize("policy", ["none", "type", "naive"])
+def test_dropout_mc_mesh_bit_identical(policy):
+    """Satellite: the chain-sharded path on a 1-device mesh is bit-identical
+    to the plain vmapped path (same global key stream)."""
+    dense, _ = _tiny(seed=4, n=20, k=4)
+    red = TypeReduction(dense)
+    P = np.zeros((2, dense.n), dtype=bool)
+    P[0, [0, 1, 2, 3]] = True
+    P[1, [4, 5, 6, 7]] = True
+    probs = np.array([0.6, 0.4])
+    w = np.linspace(0.5, 1.0, dense.n)
+    key = jax.random.PRNGKey(5)
+    a = dropout_realization_round(P, probs, w, red.type_id, dense, key, 128, policy=policy)
+    b = dropout_realization_round(
+        P, probs, w, red.type_id, dense, key, 128, policy=policy, mesh=make_mesh(1)
+    )
+    assert np.array_equal(a.counts, b.counts)
+    assert a.quota_ok_rate == b.quota_ok_rate
+
+
+def test_dropout_beats_naive_redraw_baseline_mc():
+    """Acceptance: dropout-aware portfolio + type replacement beats the
+    attendance-blind portfolio + naive re-draw on MC realized-min."""
+    dense, space = _tiny(seed=0)
+    drop = _hetero_dropout(dense.n, seed=0)
+    cfg = default_config().replace(scenario_mc_draws=0)
+    d = find_distribution_dropout(dense, space, dropout=drop, cfg=cfg)
+    plain = find_distribution_leximin(dense, space, cfg=cfg)
+
+    class _Baseline:
+        committees = plain.committees
+        probabilities = plain.probabilities
+        attendance = d.attendance
+        type_id = TypeReduction(dense).type_id
+        covered = plain.covered
+
+    draws = 8_192
+    ours = evaluate_realization(d, dense, draws=draws, policy="type", seed=0)
+    base = evaluate_realization(_Baseline(), dense, draws=draws, policy="naive", seed=0)
+    assert ours["realized_min"] > base["realized_min"]
+
+
+# --- multi-assembly scheduling -----------------------------------------------
+
+
+def test_multi_zero_repeats_contract_and_pair_gauge():
+    dense, space = _tiny(seed=0)
+    R = 3
+    m = find_distribution_multi(dense, space, rounds=R)
+    assert m.contract_ok and m.realization_dev <= 1e-3
+    assert len(m.round_portfolios) == R == len(m.round_probabilities)
+    # pair gauge is against the uniform pair value and must carry real mass
+    assert m.pair_uniform > 0 and m.pair_ratio >= 1.0 - 1e-9
+    assert m.scenario_audit["model"] == "multi"
+    # zero repeats on every drawn schedule
+    for seed in range(5):
+        sched = m.realize(seed=seed)
+        assert sched.shape == (R, dense.k)
+        flat = sched.ravel()
+        assert len(np.unique(flat)) == flat.size, "agent seated twice"
+
+
+def test_multi_aggregate_certificate_caps():
+    """Aggregate (≥1-of-R) values are true probabilities: within [0, 1] and
+    consistent with the capped composition support."""
+    dense, space = _tiny(seed=5)
+    m = find_distribution_multi(dense, space, rounds=2)
+    assert np.all(m.fixed_probabilities <= 1.0 + 1e-9)
+    assert np.all(m.fixed_probabilities >= -1e-12)
+    assert float(m.allocation.sum()) == pytest.approx(2 * dense.k, abs=1e-6)
+
+
+def test_multi_rfold_fleet_through_batch_lp():
+    """The R per-round ε-LPs go through the batched engine as one fleet
+    (cross-fleet bucketing: ≥ R solves, at least one dispatch)."""
+    dense, space = _tiny(seed=0)
+    log = RunLog(echo=False)
+    cfg = default_config().replace(lp_batch=True)
+    R = 3
+    m = find_distribution_multi(dense, space, rounds=R, cfg=cfg, log=log)
+    assert m.scenario_audit["fleet_backend"] == "batch_lp"
+    assert log.counters.get("lp_batch_solves", 0) >= R
+    assert log.counters.get("lp_batch_dispatches", 0) >= 1
+    assert m.contract_ok
+
+
+def test_multi_infeasible_rounds():
+    dense, space = _tiny(seed=0, n=12, k=5)
+    with pytest.raises(SchedulingInfeasible):
+        find_distribution_multi(dense, space, rounds=4)
+
+
+def test_multi_rejects_households_and_bad_rounds():
+    dense, space = _tiny(seed=0)
+    with pytest.raises(ScenarioError):
+        find_distribution_multi(
+            dense, space, rounds=2, households=np.zeros(dense.n, dtype=np.int64)
+        )
+    with pytest.raises(ScenarioError):
+        find_distribution_multi(dense, space, rounds=0)
+
+
+# --- service integration ------------------------------------------------------
+
+
+def test_service_scenario_algorithms():
+    from citizensassemblies_tpu.service.server import SelectionRequest, SelectionService
+
+    cfg = default_config().replace(scenario_mc_draws=256)
+    svc = SelectionService(cfg)
+    try:
+        inst = random_instance(n=24, k=5, n_categories=2, seed=1)
+        drop = _hetero_dropout(24, seed=1, hi=0.4)
+        r1 = svc.submit(
+            SelectionRequest(algorithm="dropout", instance=inst, dropout=drop)
+        ).result(timeout=600)
+        assert r1.audit["scenario"]["model"] == "dropout"
+        assert "mc" in r1.audit["scenario"]
+        assert r1.audit["contract_ok"]
+
+        r2 = svc.submit(
+            SelectionRequest(algorithm="multi", instance=inst, rounds=2)
+        ).result(timeout=600)
+        assert r2.audit["scenario"]["model"] == "multi"
+        assert r2.audit["scenario"]["pair_ratio"] >= 1.0 - 1e-9
+
+        # a dropout request without the dropout vector is a clean error
+        with pytest.raises(RuntimeError):
+            svc.submit(
+                SelectionRequest(algorithm="dropout", instance=inst)
+            ).result(timeout=600)
+    finally:
+        svc.shutdown()
+
+
+def test_service_dropout_fingerprint_distinguishes_profiles():
+    """Two dropout requests on the same instance with different no-show
+    vectors must not share a memo fingerprint."""
+    from citizensassemblies_tpu.service.server import SelectionRequest, SelectionService
+
+    cfg = default_config()
+    svc = SelectionService(cfg)
+    try:
+        dense, _ = _tiny(seed=0)
+        r_a = SelectionRequest(algorithm="dropout", dense=dense, dropout=np.full(dense.n, 0.1))
+        r_b = SelectionRequest(algorithm="dropout", dense=dense, dropout=np.full(dense.n, 0.3))
+        r_m = SelectionRequest(algorithm="multi", dense=dense, rounds=2)
+        r_m2 = SelectionRequest(algorithm="multi", dense=dense, rounds=3)
+        fps = {
+            svc._fingerprint(r, dense, cfg) for r in (r_a, r_b, r_m, r_m2)
+        }
+        assert len(fps) == 4
+    finally:
+        svc.shutdown()
+
+
+# --- gate-off parity ----------------------------------------------------------
+
+
+def test_existing_models_bit_identical_with_scenarios_unused():
+    """Acceptance: with the scenario knobs changed but scenarios unused, the
+    existing models produce bit-identical results — the subsystem is inert
+    unless invoked."""
+    dense, space = _tiny(seed=0)
+    base = find_distribution_leximin(dense, space, cfg=default_config())
+    tweaked = find_distribution_leximin(
+        dense,
+        space,
+        cfg=default_config().replace(
+            scenario_dropout_buckets=9,
+            scenario_replacement="naive",
+            scenario_rounds=7,
+            scenario_mc_draws=17,
+        ),
+    )
+    assert np.array_equal(base.allocation, tweaked.allocation)
+    assert np.array_equal(base.probabilities, tweaked.probabilities)
+    assert np.array_equal(base.committees, tweaked.committees)
