@@ -1903,6 +1903,278 @@ def trend() -> int:
     return 0 if report.ok else 1
 
 
+def _dist_scope_caches() -> None:
+    """Reset the platform + scope the XLA persistent compilation cache to
+    THIS run (the ``__graft_entry__.dryrun_multichip`` recipe): the package
+    points the cache at a shared ~/.cache directory, and a forced-device
+    run then tries to load AOT artifacts persisted by other
+    machines/topologies — every miss is a ``cpu_aot_loader``
+    machine-mismatch warning that buries the report line the artifact tail
+    exists to show. The parent asserts the tail is clean."""
+    import tempfile
+
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in want.split(","):
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            tempfile.mkdtemp(prefix="citizens_dist_xla_"),
+        )
+    except Exception:
+        pass
+
+
+def dist_bench_child(smoke_mode: bool) -> int:
+    """``bench.py --dist`` (child, forced-device process): the graftpod
+    weak-scaling row family — MEASURED, not dryrun.
+
+    Per mesh size (1/2/4/8 virtual devices, capped at what XLA exposes):
+    MC panels/s through the production ``distributed_sample_panels`` path at
+    a fixed per-device batch (weak scaling: total work grows with the mesh),
+    and sharded dual-LP wall-clock over the registry portfolio. Every size
+    enforces the exactness contract — panels bit-identical to the
+    undistributed kernel (the 1-device case pins the undistributed path
+    itself), allocation L∞ ≤ 1e-3 vs the host reference, dual objective
+    within 1e-3 of the exact HiGHS LP — and the steady-state repeat round
+    must add ZERO ``dist_reshards`` (declared-once shardings hand off
+    without re-layout). The honest-hardware rule: the ≥ 4× 1→8 gate is
+    enforced only when the host has at least as many cores as devices;
+    virtual devices multiplexed onto fewer cores measure dispatch overhead,
+    not parallelism, and the artifact records the waiver instead of a fake
+    ratio.
+    """
+    _dist_scope_caches()
+
+    import jax
+    import numpy as np
+
+    from citizensassemblies_tpu.data import nationwide_registry
+    from citizensassemblies_tpu.dist import partition as dist_partition
+    from citizensassemblies_tpu.dist import runtime as dist_runtime
+    from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+    from citizensassemblies_tpu.parallel.mc import (
+        distributed_allocation,
+        distributed_sample_panels,
+    )
+    from citizensassemblies_tpu.parallel.mesh import make_mesh
+    from citizensassemblies_tpu.parallel.solver import solve_dual_lp_pdhg_sharded
+    from citizensassemblies_tpu.solvers.highs_backend import solve_dual_lp
+    from citizensassemblies_tpu.utils.logging import RunLog
+
+    n_visible = len(jax.devices())
+    sizes = [s for s in (1, 2, 4, 8) if s <= n_visible]
+    host_cores = os.cpu_count() or 1
+    if smoke_mode:
+        n, per_dev_b, lp_rows, reps = 800, 32, 512, 1
+    else:
+        # sized so the full family (4 mesh sizes × warm+reps, MC + sharded
+        # dual LP + exact HiGHS reference) fits a small CI host; the
+        # registry generator itself scales to n = 10⁶ when hardware does
+        n, per_dev_b, lp_rows, reps = 2000, 48, 768, 2
+
+    reg = nationwide_registry(n=n, seed=0)
+    dense, _space = reg.to_dense()
+    key = jax.random.PRNGKey(0)
+    log = RunLog(echo=False)
+    failures: list = []
+
+    mc_rows = []
+    for nd in sizes:
+        mesh = make_mesh(nd)
+        B = nd * per_dev_b
+        # reference: the undistributed scan kernel at the same total batch
+        ref_p, ref_ok = _sample_panels_kernel(dense, key, B)
+        ref_p = np.asarray(ref_p)
+        ref_ok = np.asarray(ref_ok)
+        # warm-up compiles + steady-state reshard audit: the repeat round
+        # must be pure pass-through placement
+        distributed_sample_panels(dense, key, B, mesh, log=log)
+        before = dist_partition.reshard_count(log)
+        p, ok = distributed_sample_panels(dense, key, B, mesh, log=log)
+        jax.block_until_ready((p, ok))
+        steady_reshards = dist_partition.reshard_count(log) - before
+        bit_identical = np.array_equal(np.asarray(p), ref_p) and np.array_equal(
+            np.asarray(ok), ref_ok
+        )
+        t0 = time.time()
+        for _ in range(reps):
+            p, ok = distributed_sample_panels(dense, key, B, mesh, log=log)
+            jax.block_until_ready((p, ok))
+        dt = time.time() - t0
+        row = {
+            "devices": nd,
+            "batch": B,
+            "panels_per_s": round(reps * B / max(dt, 1e-9), 1),
+            "bit_identical": bool(bit_identical),
+            "steady_reshards": int(steady_reshards),
+        }
+        mc_rows.append(row)
+        if not bit_identical:
+            failures.append(f"mc bit-identity broke at {nd} devices")
+        if steady_reshards:
+            failures.append(
+                f"{steady_reshards} steady-state reshard(s) at {nd} devices"
+            )
+
+    # sharded dual-LP throughput + exactness vs the host LP, per mesh size
+    from citizensassemblies_tpu.models.legacy import sample_feasible_panels
+
+    dual_panels, _draws = sample_feasible_panels(
+        dense, lp_rows, seed=2, distribute=False
+    )
+    P_dual = np.zeros((lp_rows, dense.n), dtype=bool)
+    for r, prow in enumerate(dual_panels):
+        P_dual[r, prow] = True
+    fixed = np.full(dense.n, -1.0)
+    exact = solve_dual_lp(P_dual, fixed)
+    lp_rows_out = []
+    for nd in sizes:
+        mesh = make_mesh(nd)
+        sharded = solve_dual_lp_pdhg_sharded(P_dual, fixed, mesh)  # warm-up
+        t0 = time.time()
+        for _ in range(reps):
+            sharded = solve_dual_lp_pdhg_sharded(P_dual, fixed, mesh)
+        dt = time.time() - t0
+        obj_gap = abs(float(sharded.objective) - float(exact.objective))
+        row = {
+            "devices": nd,
+            "portfolio_rows": lp_rows,
+            "solves_per_s": round(reps / max(dt, 1e-9), 3),
+            "objective_gap": round(obj_gap, 8),
+            "converged": bool(sharded.ok),
+        }
+        lp_rows_out.append(row)
+        if not sharded.ok:
+            failures.append(f"sharded dual LP did not converge at {nd} devices")
+        if obj_gap > 1e-3:
+            failures.append(
+                f"dual objective gap {obj_gap:.2e} > 1e-3 at {nd} devices"
+            )
+
+    # allocation L∞ contract: the sharded portfolio matvec vs host numpy
+    probs = np.full(lp_rows, 1.0 / lp_rows, dtype=np.float32)
+    host_alloc = P_dual.astype(np.float32).T @ probs
+    alloc_linf = []
+    for nd in sizes:
+        mesh = make_mesh(nd)
+        alloc = np.asarray(
+            distributed_allocation(
+                P_dual.astype(np.float32), probs, mesh, log=log
+            )
+        )
+        linf = float(np.max(np.abs(alloc - host_alloc)))
+        alloc_linf.append({"devices": nd, "linf": round(linf, 8)})
+        if linf > 1e-3:
+            failures.append(f"allocation L∞ {linf:.2e} > 1e-3 at {nd} devices")
+
+    # honest weak-scaling verdict: ratio is measured either way; the ≥ 4×
+    # gate binds only when the host can actually run the devices in parallel
+    r1 = next((r["panels_per_s"] for r in mc_rows if r["devices"] == 1), None)
+    r8 = next((r["panels_per_s"] for r in mc_rows if r["devices"] == sizes[-1]), None)
+    ratio = round(r8 / r1, 3) if r1 and r8 else None
+    gate_enforced = host_cores >= sizes[-1] and not smoke_mode
+    waiver = None
+    if not gate_enforced:
+        waiver = (
+            f"host_cores={host_cores} < devices={sizes[-1]}: forced virtual "
+            "devices multiplex onto the same core(s), so throughput measures "
+            "dispatch overhead, not parallel speedup — the >=4x gate needs "
+            "real parallel hardware"
+            if host_cores < sizes[-1]
+            else "smoke mode: timing too short to gate on"
+        )
+    elif ratio is not None and ratio < 4.0:
+        failures.append(
+            f"weak-scaling 1->{sizes[-1]} ratio {ratio} < 4.0 with "
+            f"{host_cores} host cores available"
+        )
+
+    report = {
+        "metric": "dist_weak_scaling",
+        "dryrun": False,
+        "smoke": smoke_mode,
+        "host_cores": host_cores,
+        "visible_devices": n_visible,
+        "mesh_sizes": sizes,
+        "registry": {"n": reg.n, "k": reg.k, "households": reg.n_households},
+        "mc": mc_rows,
+        "dual_lp": lp_rows_out,
+        "allocation_linf": alloc_linf,
+        "scaling": {
+            "mc_ratio_1_to_max": ratio,
+            "gate_enforced": gate_enforced,
+            "waiver": waiver,
+        },
+        "dist_reshards_total": dist_partition.reshard_count(log),
+        "mesh_gauges": {
+            k: v for k, v in sorted(log.counters.items())
+            if k.startswith("dist_")
+        },
+        "failures": failures,
+    }
+    print(json.dumps(report))
+    return 1 if failures else 0
+
+
+def dist_bench(smoke_mode: bool) -> int:
+    """``bench.py --dist`` (parent): re-exec the child under forced host
+    devices, assert its output tail is clean of ``cpu_aot_loader``
+    machine-mismatch spam (the scoped-cache contract), and commit the
+    measured report to ``artifacts/MULTICHIP_weak_scaling.json`` — the
+    honest replacement for the dryrun MULTICHIP_r0x artifact family."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["BENCH_DIST_CHILD"] = "1"
+    cmd = [sys.executable, os.path.abspath(__file__), "--dist"]
+    if smoke_mode:
+        cmd.append("--smoke")
+    proc = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=3600
+    )
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+
+    # satellite contract: the run tail shows the report, not AOT-cache spam
+    tail = "\n".join((proc.stdout + "\n" + proc.stderr).splitlines()[-25:])
+    for marker in ("cpu_aot_loader", "machine mismatch"):
+        if marker in tail:
+            print(f"dist bench FAILED: '{marker}' spam in the run tail")
+            return 1
+
+    report = None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                report = json.loads(line)
+                break
+            except ValueError:
+                continue
+    if report is None:
+        print("dist bench FAILED: no report line from the child")
+        return 1
+    out_path = os.path.join(_artifacts_dir(), "MULTICHIP_weak_scaling.json")
+    try:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+    except OSError:
+        pass
+    return proc.returncode
+
+
 if __name__ == "__main__":
     if "--trend" in sys.argv:
         raise SystemExit(trend())
@@ -1912,6 +2184,10 @@ if __name__ == "__main__":
         raise SystemExit(scenario_bench(smoke_mode="--smoke" in sys.argv))
     if "--serve" in sys.argv:
         raise SystemExit(serve_bench(smoke_mode="--smoke" in sys.argv))
+    if "--dist" in sys.argv:
+        if os.environ.get("BENCH_DIST_CHILD"):
+            raise SystemExit(dist_bench_child(smoke_mode="--smoke" in sys.argv))
+        raise SystemExit(dist_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         raise SystemExit(smoke())
     main()

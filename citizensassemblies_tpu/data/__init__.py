@@ -1,0 +1,6 @@
+"""Instance families: the nationwide civic-lottery registry generator."""
+
+from citizensassemblies_tpu.data.registry import (  # noqa: F401
+    Registry,
+    nationwide_registry,
+)

@@ -1,0 +1,247 @@
+"""Seeded synthetic nationwide-registry instances (the graftpod workload).
+
+The source paper's deployments select from pools of hundreds to a few
+thousand volunteers; the self-selection line of work points at the real
+target — a standing nationwide civic-lottery registry with n = 10⁵-10⁶
+volunteers and thousands of household classes. This module generates that
+instance family at scale:
+
+* **Vectorized all the way.** ``core/generator.py`` builds agents as a list
+  of per-agent dicts, which is fine at n ≤ 10⁴ and hopeless at 10⁶ (tens of
+  seconds and ~1 GB of dict overhead). Here the pool is a single
+  ``int32[n, C]`` assignment matrix drawn per category from a seeded
+  Dirichlet-weighted categorical, and :meth:`Registry.to_dense` lowers it
+  straight to the ``DenseInstance`` incidence arrays with numpy scatter —
+  no per-agent Python objects anywhere. ``to_instance()`` exists for
+  interop with the CSV-shaped pipeline and is priced for modest n only.
+
+* **Feasible quotas by construction.** Quotas are synthesized around a
+  *witness panel*: draw k agents uniformly without replacement, count their
+  per-cell composition, and bracket each cell's quota around that count
+  with a ±slack band. The witness satisfies every quota by definition, so
+  the instance is feasible with a checkable certificate
+  (:meth:`Registry.check_witness`), and per-category quota sums
+  automatically bracket k (they sum to k at the witness point).
+
+* **Household classes.** Every agent carries a household id over a
+  configurable class count (≥ 5k at the nationwide tier — the scale that
+  justifies a sharded mesh), consumable by the samplers' ``households``
+  argument.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from citizensassemblies_tpu.core.instance import (
+    DenseInstance,
+    FeatureSpace,
+    HostView,
+    Instance,
+)
+
+#: default civic-lottery demography: (category, features) in file order.
+DEFAULT_CATEGORIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("gender", ("female", "male")),
+    ("age", ("16-24", "25-34", "35-44", "45-54", "55-64", "65-74", "75+")),
+    (
+        "region",
+        tuple(f"region_{i:02d}" for i in range(12)),
+    ),
+    ("education", ("none", "secondary", "vocational", "tertiary")),
+    ("urbanicity", ("urban", "suburban", "rural")),
+)
+
+
+@dataclasses.dataclass
+class Registry:
+    """A generated nationwide-registry instance (host-side, all numpy).
+
+    ``assignments[i, c]`` is agent i's feature index within category c;
+    ``qmin``/``qmax`` are flat per-cell quotas in ``FeatureSpace`` order;
+    ``witness`` is the k-panel the quotas were synthesized around (the
+    feasibility certificate); ``household_id`` labels household classes.
+    """
+
+    name: str
+    k: int
+    categories: Tuple[str, ...]
+    features: Tuple[Tuple[str, ...], ...]
+    assignments: np.ndarray  # int32[n, C]
+    qmin: np.ndarray  # int32[F]
+    qmax: np.ndarray  # int32[F]
+    household_id: np.ndarray  # int32[n]
+    witness: np.ndarray  # int64[k], sorted agent ids
+    seed: int
+
+    @property
+    def n(self) -> int:
+        return int(self.assignments.shape[0])
+
+    @property
+    def n_categories(self) -> int:
+        return int(self.assignments.shape[1])
+
+    @property
+    def n_households(self) -> int:
+        return int(self.household_id.max()) + 1 if self.household_id.size else 0
+
+    @property
+    def cell_offsets(self) -> np.ndarray:
+        """Flat-cell index of each category's first feature."""
+        sizes = np.asarray([len(f) for f in self.features], dtype=np.int64)
+        return np.concatenate([[0], np.cumsum(sizes)[:-1]])
+
+    def incidence(self) -> np.ndarray:
+        """bool[n, F] agent×cell incidence, built by vectorized scatter."""
+        n, C = self.assignments.shape
+        F = int(sum(len(f) for f in self.features))
+        A = np.zeros((n, F), dtype=bool)
+        offsets = self.cell_offsets
+        rows = np.arange(n)
+        for c in range(C):
+            A[rows, offsets[c] + self.assignments[:, c]] = True
+        return A
+
+    def check_witness(self) -> bool:
+        """Re-verify the feasibility certificate: the witness panel has k
+        distinct members and satisfies every cell quota."""
+        if len(np.unique(self.witness)) != self.k:
+            return False
+        counts = self.incidence()[self.witness].sum(axis=0)
+        return bool(np.all((counts >= self.qmin) & (counts <= self.qmax)))
+
+    def to_dense(self) -> Tuple[DenseInstance, FeatureSpace]:
+        """Lower straight to the device representation (no per-agent dicts
+        — this is the only path priced for n = 10⁶)."""
+        import jax.numpy as jnp
+
+        A = self.incidence()
+        qmin = self.qmin.astype(np.int32)
+        qmax = self.qmax.astype(np.int32)
+        cat_of_feature = np.concatenate(
+            [
+                np.full(len(feats), ci, dtype=np.int32)
+                for ci, feats in enumerate(self.features)
+            ]
+        )
+        dense = DenseInstance(
+            A=jnp.asarray(A),
+            qmin=jnp.asarray(qmin),
+            qmax=jnp.asarray(qmax),
+            cat_of_feature=jnp.asarray(cat_of_feature),
+            k=self.k,
+            n_categories=len(self.categories),
+            host=HostView(A, qmin, qmax),
+        )
+        space = FeatureSpace(
+            categories=self.categories,
+            cells=tuple(
+                (cat, feat)
+                for cat, feats in zip(self.categories, self.features)
+                for feat in feats
+            ),
+        )
+        return dense, space
+
+    def to_instance(self) -> Instance:
+        """CSV-shaped host container (per-agent dicts — modest n only)."""
+        cat_quotas = {}
+        flat = 0
+        for cat, feats in zip(self.categories, self.features):
+            cat_quotas[cat] = {
+                feat: (int(self.qmin[flat + j]), int(self.qmax[flat + j]))
+                for j, feat in enumerate(feats)
+            }
+            flat += len(feats)
+        agents = [
+            {
+                cat: self.features[c][self.assignments[i, c]]
+                for c, cat in enumerate(self.categories)
+            }
+            for i in range(self.n)
+        ]
+        return Instance(
+            k=self.k, categories=cat_quotas, agents=agents, name=self.name
+        )
+
+
+def nationwide_registry(
+    n: int = 100_000,
+    seed: int = 0,
+    k: Optional[int] = None,
+    categories: Optional[Sequence[Tuple[str, Sequence[str]]]] = None,
+    household_classes: Optional[int] = None,
+    quota_slack: float = 0.08,
+    name: str = "",
+) -> Registry:
+    """Generate a seeded nationwide-registry instance of ``n`` volunteers.
+
+    The same ``(n, seed, …)`` always yields the identical registry (numpy
+    ``default_rng`` stream, no global state). ``quota_slack`` is the ±band
+    around the witness composition, as a fraction of k (floored at ±1 seat,
+    so every instance has real selection freedom without losing the
+    witness-feasibility guarantee). ``household_classes`` defaults to
+    ``max(5000, n // 3)`` capped at n — the nationwide tier's ≥ 5k classes
+    — and scales down to ``n // 3`` on small test instances.
+    """
+    if n <= 0:
+        raise ValueError(f"registry size n={n} must be positive")
+    rng = np.random.default_rng(seed)
+    cats = tuple(
+        (str(c), tuple(str(f) for f in feats))
+        for c, feats in (categories or DEFAULT_CATEGORIES)
+    )
+    cat_names = tuple(c for c, _ in cats)
+    cat_feats = tuple(f for _, f in cats)
+
+    if k is None:
+        k = int(max(24, min(400, round(n ** 0.5))))
+    if k > n:
+        raise ValueError(f"panel size k={k} exceeds pool size n={n}")
+
+    # per-category Dirichlet-weighted categorical marginals: skewed enough
+    # to look like census marginals, never degenerate (alpha > 1)
+    assignments = np.empty((n, len(cats)), dtype=np.int32)
+    for c, feats in enumerate(cat_feats):
+        probs = rng.dirichlet(np.full(len(feats), 4.0))
+        assignments[:, c] = rng.choice(len(feats), size=n, p=probs)
+
+    # household classes: contiguous labels over the configured class count
+    H = household_classes
+    if H is None:
+        H = min(n, max(5000, n // 3)) if n >= 5000 else max(1, n // 3)
+    H = max(1, min(int(H), n))
+    household_id = rng.integers(0, H, size=n, dtype=np.int32)
+    # guarantee every class is inhabited (cardinality is part of the tier
+    # contract): deal the first H agents one class each, then shuffle
+    household_id[:H] = np.arange(H, dtype=np.int32)
+    rng.shuffle(household_id)
+
+    # witness panel → quotas bracketing its composition (feasible by
+    # construction; the witness is retained as the certificate)
+    witness = np.sort(rng.choice(n, size=k, replace=False)).astype(np.int64)
+    slack = max(1, int(round(quota_slack * k)))
+    qmin_parts, qmax_parts = [], []
+    for c, feats in enumerate(cat_feats):
+        counts = np.bincount(assignments[witness, c], minlength=len(feats))
+        qmin_parts.append(np.maximum(0, counts - slack))
+        qmax_parts.append(np.minimum(k, counts + slack))
+    qmin = np.concatenate(qmin_parts).astype(np.int32)
+    qmax = np.concatenate(qmax_parts).astype(np.int32)
+
+    return Registry(
+        name=name or f"registry_n{n}_s{seed}",
+        k=int(k),
+        categories=cat_names,
+        features=cat_feats,
+        assignments=assignments,
+        qmin=qmin,
+        qmax=qmax,
+        household_id=household_id,
+        witness=witness,
+        seed=int(seed),
+    )

@@ -1,0 +1,22 @@
+"""graftpod: the multi-host distributed runtime (`dist/runtime`) and the
+pre-partitioned input feeding layer (`dist/partition`).
+
+`runtime` owns process bootstrap (`jax.distributed.initialize` when a
+coordinator is configured, single-process fallback otherwise), the canonical
+mesh axis names, and the hosts×devices topology that `parallel/mesh.py`
+delegates to. `partition` owns the declared-once NamedSharding specs the
+pjit'd stages hand arrays off with, plus the `dist_reshards` accounting that
+proves the steady state moves zero bytes between shardings.
+"""
+
+from citizensassemblies_tpu.dist.runtime import (  # noqa: F401
+    AXIS_AGENTS,
+    AXIS_CHAINS,
+    CHAIN_AXES,
+    Topology,
+    bootstrap,
+    default_topology,
+    effective_mesh,
+    process_slice,
+    topology_mesh,
+)

@@ -1,0 +1,142 @@
+"""graftpod partitioning: declared-once sharding specs + reshard accounting.
+
+SNIPPETS.md's pjit excerpts ([1]-[3]) prescribe the pod idiom this module
+implements: inputs are **pre-partitioned** once, into the same NamedSharding
+every consuming stage declares, so pjit'd stages hand arrays to each other
+without XLA inserting a resharding collective between them. The specs for
+the two shardable axes live here and only here:
+
+* the **Monte-Carlo chain axis** (``parallel/mc.py``): key streams and chain
+  batches shard their leading axis over every mesh device
+  (:func:`chain_batch`), portfolios shard rows over ``chains`` and the agent
+  dimension over ``agents`` (:func:`portfolio`, :func:`chain_rows`);
+* the **batch-LP bucket axis** (``solvers/batch_lp.py`` /
+  ``service/batcher.py``): padded bucket operands shard their leading
+  (instance) axis over the whole mesh (:func:`bucket`).
+
+:func:`prepartition` is the single placement point. It distinguishes the
+three cases the ``dist_reshards`` contract cares about: an operand already
+in the declared sharding passes through untouched (the steady state — zero
+cost, zero count); a host array is uploaded once and counted as a
+``dist_placements``; a *device* array committed to a different sharding is
+re-laid-out and counted as a ``dist_reshards`` — the bug class this gauge
+exists to keep at zero (``bench.py --dist`` asserts the steady-state round
+counts none, the same enforcement shape as ``decomp_host_syncs``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from citizensassemblies_tpu.dist.runtime import AXIS_AGENTS, AXIS_CHAINS, CHAIN_AXES
+from citizensassemblies_tpu.utils.memo import LRU
+
+# Declared-once spec cache: NamedSharding construction is cheap but the
+# contract is identity — every stage that names the same (mesh, role, ndim)
+# must hand off THE SAME sharding object family, so equality checks in
+# prepartition are structural no-ops in the steady state. Mesh-keyed LRU,
+# same eviction discipline as the shard_map memo caches (graftlint R10).
+_SPEC_CACHE: LRU = LRU(cap=32, name="dist_specs")
+
+
+def _cached(mesh: Mesh, role: str, ndim: int, spec: P) -> NamedSharding:
+    key = (mesh, role, ndim)
+    sh = _SPEC_CACHE.get(key)
+    if sh is None:
+        sh = NamedSharding(mesh, spec)
+        _SPEC_CACHE[key] = sh
+    return sh
+
+
+def chain_batch(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Leading axis over EVERY mesh device (chains and agents axes both):
+    the layout of per-chain key streams and chain-sharded draw batches."""
+    return _cached(
+        mesh, "chain_batch", ndim, P(CHAIN_AXES, *([None] * (ndim - 1)))
+    )
+
+
+def portfolio(mesh: Mesh) -> NamedSharding:
+    """Committee matrices: rows over ``chains``, agent axis over ``agents``."""
+    return _cached(mesh, "portfolio", 2, P(AXIS_CHAINS, AXIS_AGENTS))
+
+
+def chain_rows(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Leading axis over ``chains`` only (per-panel probability vectors)."""
+    return _cached(
+        mesh, "chain_rows", ndim, P(AXIS_CHAINS, *([None] * (ndim - 1)))
+    )
+
+
+def bucket(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Batch-LP bucket operands: the padded instance axis over the whole
+    mesh (both axes), trailing dims replicated."""
+    return _cached(
+        mesh, "bucket", ndim, P(mesh.axis_names, *([None] * (ndim - 1)))
+    )
+
+
+def replicated(mesh: Mesh, ndim: int = 0) -> NamedSharding:
+    return _cached(mesh, "replicated", ndim, P())
+
+
+def _placed_like(x, sharding: NamedSharding) -> bool:
+    """Is ``x`` already a device array committed to ``sharding``?"""
+    if not isinstance(x, jax.Array):
+        return False
+    cur = getattr(x, "sharding", None)
+    if cur is None:
+        return False
+    try:
+        return cur.is_equivalent_to(sharding, x.ndim)
+    except Exception:
+        return cur == sharding
+
+
+def prepartition(x, sharding: NamedSharding, log=None):
+    """Place ``x`` into the declared sharding, counting what it cost.
+
+    Pass-through when already placed (steady state). A host operand's first
+    upload — or a fresh single-device array's (jit outputs are committed to
+    device 0 before any mesh layout exists) — counts ``dist_placements``; a
+    device array already laid out over MULTIPLE devices in the wrong spec
+    counts ``dist_reshards``: two stages declared different shardings for
+    the same hand-off, the exact bug class the pre-partitioned pipeline
+    holds at zero.
+    """
+    if _placed_like(x, sharding):
+        return x
+    if log is not None:
+        cur = getattr(x, "sharding", None) if isinstance(x, jax.Array) else None
+        try:
+            multi = cur is not None and len(cur.device_set) > 1
+        except Exception:
+            multi = cur is not None
+        log.count("dist_reshards" if multi else "dist_placements")
+    return jax.device_put(x, sharding)
+
+
+def prepartition_operands(
+    operands: Tuple, shardings: Tuple[NamedSharding, ...], log=None
+) -> Tuple:
+    """:func:`prepartition` element-wise over an operand tuple."""
+    return tuple(prepartition(x, s, log=log) for x, s in zip(operands, shardings))
+
+
+def reshard_count(log) -> int:
+    """The ``dist_reshards`` counter value on ``log`` (0 when never hit)."""
+    if log is None:
+        return 0
+    return int(log.counters.get("dist_reshards", 0))
+
+
+def spec_cache_stats() -> Optional[dict]:
+    """Visibility hook for tests: current spec-cache size."""
+    try:
+        return {"size": len(_SPEC_CACHE)}
+    except TypeError:
+        return None

@@ -1,0 +1,241 @@
+"""graftpod runtime: process bootstrap + the hosts×devices mesh topology.
+
+One module owns the three facts every distributed call site needs:
+
+* **The axis names.** ``AXIS_CHAINS``/``AXIS_AGENTS`` are the canonical
+  collective axis names of the framework's two parallel dimensions (data
+  parallelism over Monte-Carlo chains / pricing candidates, model parallelism
+  over the agent axis). Everything outside this module imports them —
+  graftlint R10 flags a hardcoded ``"chains"`` literal in a collective or
+  PartitionSpec anywhere else, because a renamed axis that half the call
+  sites missed fails only at runtime, on the biggest mesh, inside a psum.
+
+* **The process layout.** :func:`bootstrap` runs
+  ``jax.distributed.initialize`` exactly once when a coordinator is
+  configured (env vars or ``Config.dist_coordinator``) and is a no-op
+  single-process fallback otherwise, so the same entry point works on a
+  laptop, an 8-virtual-device CI host, and a real multi-host pod.
+
+* **The mesh.** :func:`build_topology` lays all visible devices out as a 2-D
+  ``chains × agents`` mesh whose chains axis spans processes host-major
+  (``jax.devices()`` is process-major, so each host's devices land in
+  contiguous chain rows — the layout under which the chain-sharded key
+  streams of ``parallel/mc.py`` feed each process's rows without crossing
+  DCN). Degrades gracefully: multi-host ⇒ hosts×local, one host ⇒ 1×N over
+  the local devices, one device ⇒ the trivial 1×1 mesh, all through the same
+  code path. ``parallel/mesh.py``'s ``make_mesh``/``default_mesh`` delegate
+  here; they are kept as the compatibility surface for existing call sites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from citizensassemblies_tpu.robust import inject
+
+#: canonical collective axis names — THE definition site (graftlint R10).
+AXIS_CHAINS = "chains"
+AXIS_AGENTS = "agents"
+#: the full data-parallel reduction set: a batch sharded over every mesh
+#: device uses both axes, and psums over this tuple reduce across the pod.
+CHAIN_AXES: Tuple[str, str] = (AXIS_CHAINS, AXIS_AGENTS)
+
+#: environment contract for multi-process bootstrap (the standard
+#: coordinator triple, prefixed so an unrelated launcher's vars don't
+#: accidentally arm a pod bootstrap).
+ENV_COORDINATOR = "CITIZENS_DIST_COORDINATOR"
+ENV_NUM_PROCESSES = "CITIZENS_DIST_NUM_PROCESSES"
+ENV_PROCESS_ID = "CITIZENS_DIST_PROCESS_ID"
+
+_LOCK = threading.Lock()
+_BOOTSTRAP: Optional["BootstrapInfo"] = None
+_DEFAULT_TOPOLOGY: Optional["Topology"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class BootstrapInfo:
+    """Outcome of :func:`bootstrap` (cached process-wide)."""
+
+    initialized: bool  # did jax.distributed.initialize actually run
+    coordinator: str  # "" on the single-process fallback
+    process_index: int
+    process_count: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """A built mesh plus the host-layout facts call sites partition by."""
+
+    mesh: Mesh
+    hosts: int  # jax process count
+    devices_per_host: int
+    agents_axis: int
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
+    @property
+    def n_devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+
+def bootstrap(cfg=None) -> BootstrapInfo:
+    """Initialize multi-process JAX when a coordinator is configured.
+
+    Consults ``CITIZENS_DIST_COORDINATOR`` / ``_NUM_PROCESSES`` /
+    ``_PROCESS_ID`` (or ``Config.dist_coordinator`` for the address when the
+    env var is absent). With no coordinator anywhere this is the
+    single-process fallback: nothing is initialized and the returned info
+    reports the process facts JAX already knows. Idempotent — the first
+    call's outcome is cached, later calls (any thread) return it.
+    """
+    global _BOOTSTRAP
+    with _LOCK:
+        if _BOOTSTRAP is not None:
+            return _BOOTSTRAP
+        coord = os.environ.get(ENV_COORDINATOR, "") or str(
+            getattr(cfg, "dist_coordinator", "") or ""
+        )
+        if coord:
+            num = int(os.environ.get(ENV_NUM_PROCESSES, "1"))
+            pid = int(os.environ.get(ENV_PROCESS_ID, "0"))
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord, num_processes=num, process_id=pid
+                )
+                initialized = True
+            except RuntimeError:
+                # already initialized by an outer launcher — keep its state
+                initialized = False
+        else:
+            initialized = False
+        _BOOTSTRAP = BootstrapInfo(
+            initialized=initialized,
+            coordinator=coord,
+            process_index=int(jax.process_index()),
+            process_count=int(jax.process_count()),
+        )
+        return _BOOTSTRAP
+
+
+def build_topology(
+    n_devices: Optional[int] = None,
+    agents_axis: int = 1,
+    axis_names: Optional[Tuple[str, str]] = None,
+    cfg=None,
+) -> Topology:
+    """Build the hosts×devices mesh as a 2-D ``chains × agents`` Mesh.
+
+    ``jax.devices()`` enumerates process-major, so the row-major reshape
+    below gives every host a contiguous block of chain rows — the property
+    :func:`process_slice` and the pre-partitioned feeding layer rely on.
+    ``agents_axis`` devices are dedicated to the agent dimension; it must
+    divide each host's share of the selected devices so no agent-sharded
+    row straddles DCN.
+    """
+    bootstrap(cfg)
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n % max(agents_axis, 1) != 0:
+        raise ValueError(f"n_devices={n} not divisible by agents_axis={agents_axis}")
+    arr = np.asarray(devices[:n]).reshape(n // agents_axis, agents_axis)
+    hosts = int(jax.process_count())
+    per_host = max(1, n // max(hosts, 1))
+    return Topology(
+        mesh=Mesh(arr, axis_names or CHAIN_AXES),
+        hosts=hosts,
+        devices_per_host=per_host,
+        agents_axis=agents_axis,
+    )
+
+
+def topology_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Optional[Tuple[str, str]] = None,
+    agents_axis: int = 1,
+) -> Mesh:
+    """Mesh-only convenience — the delegate behind ``parallel.mesh.make_mesh``."""
+    return build_topology(
+        n_devices, agents_axis=agents_axis, axis_names=axis_names
+    ).mesh
+
+
+def default_topology() -> Topology:
+    """Process-cached topology over every visible device (pure chain
+    parallelism) — the delegate behind ``parallel.mesh.default_mesh``.
+    Rebuilt when the visible device count changes (forced-device tests)."""
+    global _DEFAULT_TOPOLOGY
+    topo = _DEFAULT_TOPOLOGY
+    if topo is None or topo.n_devices != len(jax.devices()):
+        topo = build_topology()
+        _DEFAULT_TOPOLOGY = topo
+    return topo
+
+
+def effective_mesh(cfg=None, log=None) -> Optional[Mesh]:
+    """The mesh multi-device call sites should shard over, or ``None``.
+
+    ``None`` means "stay on the undistributed single-device path": either
+    only one device is visible, or ``Config.dist_mesh`` is off — the
+    ``mesh_to_single_device`` rung of the degradation ladder, which a
+    retry walks after a collective-layer fault. This is also the dist
+    collective boundary's fault site: a chaos spec arming
+    ``dist_collective`` makes handing out a multi-device mesh raise, so the
+    retry policy demonstrably lands the run on the single-device rung.
+    """
+    if cfg is not None and not getattr(cfg, "dist_mesh", True):
+        return None
+    topo = default_topology()
+    if topo.n_devices <= 1:
+        return None
+    inject.raise_if("dist_collective", log)
+    if log is not None:
+        stamp_mesh_gauges(log, topo.mesh)
+    return topo.mesh
+
+
+def process_slice(n_items: int, topo: Optional[Topology] = None) -> Tuple[int, int]:
+    """The ``[start, stop)`` share of ``n_items`` this process owns.
+
+    Host-pricing work (the ``_AnchorPricer``/``DevicePricer`` task batches)
+    partitions by this so each process prices only its mesh slice; the
+    single-process slice is the whole range, keeping the laptop/CI path
+    bit-identical to the pre-pod schedule. Items are dealt in contiguous
+    ceil-balanced blocks, same convention as the chain-axis shard layout.
+    """
+    topo = topo or default_topology()
+    hosts = max(topo.hosts, 1)
+    pid = int(jax.process_index())
+    per = -(-n_items // hosts)  # ceil
+    return min(pid * per, n_items), min((pid + 1) * per, n_items)
+
+
+def host_lane() -> int:
+    """This process's span-lane id (0 on single-process runs). grafttrace
+    dispatch spans carry it as a ``host`` attribute so a pod run's traces
+    separate per process instead of interleaving into one lane."""
+    return int(jax.process_index())
+
+
+def stamp_mesh_gauges(log, mesh: Mesh) -> None:
+    """Latest-wins mesh gauges on the metrics registry: how many hosts and
+    devices the current mesh spans, and which process stamped it."""
+    log.gauge("dist_mesh_hosts", int(jax.process_count()))
+    log.gauge("dist_mesh_devices", int(mesh.devices.size))
+    log.gauge("dist_process_index", int(jax.process_index()))
+
+
+def reset_for_tests() -> None:
+    """Drop the cached bootstrap/topology (test isolation only)."""
+    global _BOOTSTRAP, _DEFAULT_TOPOLOGY
+    with _LOCK:
+        _BOOTSTRAP = None
+        _DEFAULT_TOPOLOGY = None

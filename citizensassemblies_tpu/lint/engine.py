@@ -174,7 +174,7 @@ def iter_python_files(paths: Iterable[Path]) -> List[Path]:
 
 
 def all_rules():
-    """The registered rule set, R1..R9 (R0 is emitted by the engine itself)."""
+    """The registered rule set, R1..R10 (R0 is emitted by the engine itself)."""
     from citizensassemblies_tpu.lint.config_rule import ConfigKnobRule
     from citizensassemblies_tpu.lint.rules import (
         CoreSpanRule,
@@ -183,6 +183,7 @@ def all_rules():
         FaultSiteRule,
         HostSyncInJitRule,
         JitConstructionRule,
+        MeshHygieneRule,
         ThreadDisciplineRule,
         TracerBranchRule,
     )
@@ -197,6 +198,7 @@ def all_rules():
         ThreadDisciplineRule(),
         CoreSpanRule(),
         FaultSiteRule(),
+        MeshHygieneRule(),
     ]
 
 

@@ -264,6 +264,10 @@ def sample_feasible_panels(
     cfg = cfg or default_config()
     if num <= 0:
         return np.zeros((0, dense.k), dtype=np.int32), 0
+    if distribute is None and not getattr(cfg, "dist_mesh", True):
+        # mesh_to_single_device rung: the auto-distribution hook stays on
+        # the single-device kernel (bit-identical — the rung's certificate)
+        distribute = False
     if key is None:
         key = jax.random.PRNGKey(seed)
     B = min(cfg.mc_batch, max(256, num))

@@ -57,6 +57,12 @@ def dispatch_span(name: str, cfg=None, log=None, **attrs):
         yield _INERT
         return
     scope = DispatchScope()
+    # pod runs: every span carries its process index so merged multi-host
+    # trace files separate into per-host lanes (0 on single-process runs;
+    # lazy import keeps the obs layer free of a hard dist dependency)
+    from citizensassemblies_tpu.dist.runtime import host_lane
+
+    attrs.setdefault("host", host_lane())
     with tr.span(name, kind="dispatch", **attrs) as sp:
         yield scope
         if tr.sample_device and scope.out is not None:

@@ -26,10 +26,12 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from citizensassemblies_tpu.core.instance import DenseInstance
+from citizensassemblies_tpu.dist import partition as dist_partition
+from citizensassemblies_tpu.dist.runtime import AXIS_AGENTS, AXIS_CHAINS, CHAIN_AXES
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.models.legacy import _sample_panels_kernel, chain_keys_for
 from citizensassemblies_tpu.obs.hooks import dispatch_span
@@ -62,13 +64,13 @@ def _draw_callable(mesh: Mesh, B_local: int, sharded_scores: bool):
     key = (mesh, B_local, sharded_scores)
     fn = _DRAW_CACHE.get(key)
     if fn is None:
-        score_spec = P(("chains", "agents")) if sharded_scores else P()
+        score_spec = P(CHAIN_AXES) if sharded_scores else P()
 
         @partial(
             shard_map_compat,
             mesh=mesh,
-            in_specs=(P(), P(("chains", "agents")), score_spec, P()),
-            out_specs=(P(("chains", "agents")), P(("chains", "agents"))),
+            in_specs=(P(), P(CHAIN_AXES), score_spec, P()),
+            out_specs=(P(CHAIN_AXES), P(CHAIN_AXES)),
         )
         def fn(dense, local_keys, local_scores, households):
             return _sample_panels_kernel(
@@ -91,6 +93,7 @@ def distributed_sample_panels(
     mesh: Mesh,
     scores=None,
     households=None,
+    log=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Chain-parallel panel draw over the mesh, bit-identical to the
     single-device kernel.
@@ -123,6 +126,11 @@ def distributed_sample_panels(
         else jnp.arange(dense.n, dtype=jnp.int32)
     )
     draw = _draw_callable(mesh, B_local, sharded_scores)
+    # pre-partition the chain-axis key stream into the declared spec, so the
+    # shard_map dispatch consumes it in place instead of resharding
+    keys = dist_partition.prepartition(
+        keys, dist_partition.chain_batch(mesh, ndim=keys.ndim), log=log
+    )
     panels, ok = draw(
         dense,
         keys,
@@ -143,16 +151,16 @@ def _round_callable(mesh: Mesh, per_device_batch: int, n: int):
         @partial(
             shard_map_compat,
             mesh=mesh,
-            in_specs=(P(), P(("chains", "agents"))),
-            out_specs=(P(("chains", "agents")), P(("chains", "agents")), P(), P()),
+            in_specs=(P(), P(CHAIN_AXES)),
+            out_specs=(P(CHAIN_AXES), P(CHAIN_AXES), P(), P()),
         )
         def fn(dense, local_keys):
             panels, ok = _sample_panels_kernel(dense, local_keys[0], per_device_batch)
             S = jnp.zeros((per_device_batch, n), dtype=jnp.float32)
             S = S.at[jnp.arange(per_device_batch)[:, None], panels].set(1.0)
             S = S * ok[:, None].astype(jnp.float32)
-            counts = jax.lax.psum(jnp.sum(S, axis=0), ("chains", "agents"))
-            pair = jax.lax.psum(S.T @ S, ("chains", "agents"))
+            counts = jax.lax.psum(jnp.sum(S, axis=0), CHAIN_AXES)
+            pair = jax.lax.psum(S.T @ S, CHAIN_AXES)
             pair = pair * (1.0 - jnp.eye(n, dtype=pair.dtype))
             return panels, ok, counts, pair
 
@@ -184,22 +192,28 @@ def _matvec_callable(mesh: Mesh):
         @partial(
             shard_map_compat,
             mesh=mesh,
-            in_specs=(P("chains", "agents"), P("chains")),
-            out_specs=P("agents"),
+            in_specs=(P(AXIS_CHAINS, AXIS_AGENTS), P(AXIS_CHAINS)),
+            out_specs=P(AXIS_AGENTS),
         )
         def fn(P_local, p_local):
-            return jax.lax.psum(P_local.T @ p_local, "chains")
+            return jax.lax.psum(P_local.T @ p_local, AXIS_CHAINS)
 
         _MATVEC_CACHE[key] = fn
     return fn
 
 
-def distributed_allocation(P_matrix, probs, mesh: Mesh):
+def distributed_allocation(P_matrix, probs, mesh: Mesh, log=None):
     """π = Pᵀ p with the portfolio row-sharded over the ``chains`` axis and the
     agent axis sharded over ``agents`` — the layout used by the device LP
-    solver at large portfolio sizes."""
-    P_sharded = jax.device_put(P_matrix, NamedSharding(mesh, P("chains", "agents")))
-    p_sharded = jax.device_put(probs, NamedSharding(mesh, P("chains")))
+    solver at large portfolio sizes. Operands go through the declared-once
+    graftpod specs: a caller that keeps its portfolio resident in the
+    declared sharding pays zero placement work (``dist_reshards`` stays 0)."""
+    P_sharded = dist_partition.prepartition(
+        P_matrix, dist_partition.portfolio(mesh), log=log
+    )
+    p_sharded = dist_partition.prepartition(
+        probs, dist_partition.chain_rows(mesh), log=log
+    )
     return _matvec_callable(mesh)(P_sharded, p_sharded)
 
 
@@ -310,12 +324,12 @@ def _dropout_shard_callable(mesh: Mesh, B_local: int, policy: str):
         @partial(
             shard_map_compat,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(("chains", "agents"))),
+            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(CHAIN_AXES)),
             out_specs=(
                 P(),
                 P(),
-                P(("chains", "agents")),
-                P(("chains", "agents")),
+                P(CHAIN_AXES),
+                P(CHAIN_AXES),
             ),
         )
         def fn(Pm, cum, attend, type_id, starts, A, qmin, qmax, local_keys):
@@ -323,8 +337,8 @@ def _dropout_shard_callable(mesh: Mesh, B_local: int, policy: str):
                 Pm, cum, attend, type_id, starts, A, qmin, qmax, local_keys
             )
             return (
-                jax.lax.psum(counts, ("chains", "agents")),
-                jax.lax.psum(valid, ("chains", "agents")),
+                jax.lax.psum(counts, CHAIN_AXES),
+                jax.lax.psum(valid, CHAIN_AXES),
                 ok,
                 filled,
             )

@@ -10,21 +10,21 @@ greenfield, specified over two natural axes:
 * ``agents`` — model parallelism over the agent axis for the n×n pair matrix,
   portfolio matvecs, and dual-LP iterations at large n.
 
-Multi-host execution uses the same meshes via ``jax.distributed`` +
-``jax.sharding.Mesh`` over all processes' devices; XLA inserts the collectives
-(ICI within a slice, DCN across slices).
+Topology construction itself lives in the graftpod runtime
+(``dist/runtime.py``), which owns the canonical axis names, the multi-process
+bootstrap and the hosts×devices layout; this module is the compatibility
+surface existing call sites import (``make_mesh``/``default_mesh`` delegate)
+plus the ``shard_map`` API-migration shim.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
-import jax
-import numpy as np
 from jax.sharding import Mesh
 
-
-_DEFAULT_MESH: Optional[Mesh] = None
+from citizensassemblies_tpu.dist import runtime as _runtime
+from citizensassemblies_tpu.dist.runtime import CHAIN_AXES
 
 
 def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs):
@@ -39,6 +39,8 @@ def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs):
     working on both — without it the whole ``parallel/`` layer fails to
     even decorate on a 0.4 runtime.
     """
+    import jax
+
     if hasattr(jax, "shard_map"):
         return jax.shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
@@ -51,21 +53,19 @@ def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs):
 
 
 def default_mesh() -> Mesh:
-    """Process-wide chains×agents mesh over every visible device (cached).
+    """Process-wide chains×agents mesh over every visible device (cached in
+    the graftpod topology).
 
     The auto-distribution hook of ``sample_panels_batch`` uses this so the
     production estimator shards without the caller managing a mesh; tests and
     the driver's ``dryrun_multichip`` build explicit meshes instead.
     """
-    global _DEFAULT_MESH
-    if _DEFAULT_MESH is None or _DEFAULT_MESH.devices.size != len(jax.devices()):
-        _DEFAULT_MESH = make_mesh()
-    return _DEFAULT_MESH
+    return _runtime.default_topology().mesh
 
 
 def make_mesh(
     n_devices: Optional[int] = None,
-    axis_names: Tuple[str, str] = ("chains", "agents"),
+    axis_names: Tuple[str, str] = CHAIN_AXES,
     agents_axis: int = 1,
 ) -> Mesh:
     """Build a (chains × agents) mesh over the first ``n_devices`` devices.
@@ -73,10 +73,9 @@ def make_mesh(
     ``agents_axis`` devices are dedicated to sharding the agent dimension; the
     rest parallelize chains. Defaults to pure chain parallelism, the right
     layout for every reference-scale instance (n ≤ 2000 fits one chip).
+    Delegates to :func:`citizensassemblies_tpu.dist.runtime.build_topology`,
+    which also lays multi-process device sets out host-major.
     """
-    devices = jax.devices()
-    n = n_devices or len(devices)
-    devices = np.asarray(devices[:n])
-    if n % agents_axis != 0:
-        raise ValueError(f"n_devices={n} not divisible by agents_axis={agents_axis}")
-    return Mesh(devices.reshape(n // agents_axis, agents_axis), axis_names)
+    return _runtime.topology_mesh(
+        n_devices, axis_names=axis_names, agents_axis=agents_axis
+    )

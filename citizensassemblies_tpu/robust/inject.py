@@ -74,6 +74,10 @@ FAULT_SITES: Dict[str, str] = {
         "kills the face-decomposition loop mid-round — exercises the "
         "crash-consistent checkpoint/resume path"
     ),
+    "dist_collective": (
+        "graftpod mesh handout fails (collective init / topology build) — "
+        "exercises the mesh→single-device rung of the degradation ladder"
+    ),
 }
 
 

@@ -105,6 +105,7 @@ DEGRADATION_LADDER: Tuple[Tuple[str, Dict[str, Any]], ...] = (
     ("ell_to_dense", {"sparse_ops": False}),
     ("batched_to_serial", {"lp_batch": False}),
     ("fused_screen_to_host", {"decomp_batched_expand": False}),
+    ("mesh_to_single_device", {"dist_mesh": False}),
 )
 
 

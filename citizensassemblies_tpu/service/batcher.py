@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from citizensassemblies_tpu.dist import runtime as dist_runtime
 from citizensassemblies_tpu.robust import inject
 from citizensassemblies_tpu.utils.config import Config, default_config
 
@@ -244,9 +245,18 @@ class CrossRequestBatcher:
                     probs.append(inst)
                 merged.extend(probs)
                 spans.append((start, len(merged)))
+            # pod runs: hand the merged fleet to the engine pre-laid-out over
+            # the process's mesh slice (None on single-device topologies — the
+            # engine's host path is unchanged). Sub-device fleets stay
+            # unsharded: sharding pays only with >= one lane per device, and
+            # the unsharded dispatch is the layout the solo-solve bit-identity
+            # contract pins
+            mesh = dist_runtime.effective_mesh(cfg)
+            if mesh is not None and len(merged) < int(mesh.devices.size):
+                mesh = None
             sols = solve_lp_batch(
                 merged, cfg=cfg, log=None, warm_key=None,
-                max_iters=max_iters, defer=False,
+                max_iters=max_iters, defer=False, mesh=mesh,
             )
             n_requests = len({
                 (p.ctx.tenant, p.ctx.request_id)

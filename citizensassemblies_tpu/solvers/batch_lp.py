@@ -458,7 +458,21 @@ def solve_lp_batch(
         # (the engine's whole point is one explicit upload per bucket); with
         # a mesh the batch axis is laid out over the devices so the jitted
         # core runs SPMD-partitioned without a second code path
-        if mesh is not None and int(mesh.devices.size) > 1:
+        if (
+            mesh is not None
+            and int(mesh.devices.size) > 1
+            and getattr(cfg, "dist_prepartition", True)
+        ):
+            from citizensassemblies_tpu.dist import partition as dist_partition
+
+            raw = (c, G, h, A, b, x0, lam0, mu0, tols)
+            operands = dist_partition.prepartition_operands(
+                raw,
+                tuple(dist_partition.bucket(mesh, a.ndim) for a in raw),
+                log=log,
+            )
+        elif mesh is not None and int(mesh.devices.size) > 1:
+            # legacy per-call layout (dist_prepartition=False escape hatch)
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 

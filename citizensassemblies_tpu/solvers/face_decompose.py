@@ -56,6 +56,7 @@ from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from citizensassemblies_tpu.dist import runtime as dist_runtime
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.obs.trace import begin_span, end_span
@@ -924,6 +925,12 @@ class _AnchorPricer:
             for t in worst:
                 if deficit[t] > 0.25 * eps and self.red.msize[t] > 0:
                     tasks.append((-r_norm, int(t)))
+        # pod runs: each process prices only its contiguous slice of the
+        # anchor batch (column pools merge at the next harvest); the
+        # single-process slice is the whole list, so the schedule is
+        # bit-identical to the undistributed pricer
+        lo, hi = dist_runtime.process_slice(len(tasks))
+        tasks = tasks[lo:hi]
         if self.device is not None:
             # the accelerator is the worker: one async dispatch prices the
             # whole batch; the handle is decoded at the next harvest

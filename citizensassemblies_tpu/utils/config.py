@@ -403,6 +403,30 @@ class Config:
     #: both).
     obs_trend_tol: float = 1.75
 
+    # --- distributed runtime (citizensassemblies_tpu/dist) ---------------------
+    #: graftpod mesh gate. ``True``: shardable stages (the MC estimator's
+    #: auto-distribution hook, the cross-request batcher's engine handoff)
+    #: run over the process's ``dist.runtime`` topology whenever it spans
+    #: more than one device. ``False`` forces the undistributed single-device
+    #: paths — this is the ``mesh_to_single_device`` rung of the degradation
+    #: ladder, and the bit-identity anchor the 1-device contract is pinned
+    #: against.
+    dist_mesh: bool = True
+    #: multi-process coordinator address ("host:port"). Empty (the default)
+    #: means the ``CITIZENS_DIST_*`` environment variables decide: when they
+    #: are absent too, ``dist.runtime.bootstrap`` runs single-process without
+    #: touching ``jax.distributed``. Set (either way) alongside
+    #: ``CITIZENS_DIST_NUM_PROCESSES``/``CITIZENS_DIST_PROCESS_ID`` to join
+    #: a pod.
+    dist_coordinator: str = ""
+    #: pre-partition engine operands into the declared-once NamedSharding
+    #: specs of ``dist/partition.py`` (counted: first host upload is a
+    #: ``dist_placements``, a wrong-sharding device operand is a
+    #: ``dist_reshards`` — held at zero in steady state by ``bench.py
+    #: --dist``). ``False`` falls back to the per-call ad-hoc layout the
+    #: engine used before graftpod (kept as a diagnostic escape hatch).
+    dist_prepartition: bool = True
+
     # --- backends -------------------------------------------------------------
     #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),
     #: "highs" (host scipy/HiGHS LPs and MILPs — the cross-check backend), or

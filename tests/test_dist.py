@@ -1,0 +1,242 @@
+"""graftpod tests: dist runtime topology, pre-partitioned feeding, the
+nationwide registry generator, and the distributed↔undistributed contracts
+(1-device bit-identity, zero steady-state reshards, the mesh→single-device
+degradation rung)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from citizensassemblies_tpu.data import Registry, nationwide_registry
+from citizensassemblies_tpu.dist import partition as dist_partition
+from citizensassemblies_tpu.dist import runtime as dist_runtime
+from citizensassemblies_tpu.dist.runtime import (
+    AXIS_AGENTS,
+    AXIS_CHAINS,
+    CHAIN_AXES,
+    Topology,
+)
+from citizensassemblies_tpu.parallel.mesh import default_mesh, make_mesh
+from citizensassemblies_tpu.utils.config import default_config
+from citizensassemblies_tpu.utils.logging import RunLog
+
+
+# --- registry generator ------------------------------------------------------
+
+
+def test_registry_seed_determinism():
+    a = nationwide_registry(n=2000, seed=11)
+    b = nationwide_registry(n=2000, seed=11)
+    c = nationwide_registry(n=2000, seed=12)
+    assert np.array_equal(a.assignments, b.assignments)
+    assert np.array_equal(a.qmin, b.qmin) and np.array_equal(a.qmax, b.qmax)
+    assert np.array_equal(a.household_id, b.household_id)
+    assert np.array_equal(a.witness, b.witness)
+    assert not np.array_equal(a.assignments, c.assignments)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("n", [500, 3000])
+def test_registry_feasible_by_construction(seed, n):
+    reg = nationwide_registry(n=n, seed=seed)
+    assert reg.check_witness(), f"witness certificate failed (n={n} seed={seed})"
+    # per-category quota sums must bracket k (they bracket the witness count)
+    off = reg.cell_offsets
+    sizes = [len(f) for f in reg.features]
+    for c, size in enumerate(sizes):
+        lo = int(reg.qmin[off[c]:off[c] + size].sum())
+        hi = int(reg.qmax[off[c]:off[c] + size].sum())
+        assert lo <= reg.k <= hi
+
+
+def test_registry_household_cardinality_tiers():
+    # nationwide tier: >= 5k inhabited household classes
+    big = nationwide_registry(n=20_000, seed=0)
+    assert big.n_households >= 5000
+    assert len(np.unique(big.household_id)) == big.n_households
+    # small test instances scale the class count down instead of failing
+    small = nationwide_registry(n=900, seed=0)
+    assert 1 <= small.n_households <= 900
+    assert len(np.unique(small.household_id)) == small.n_households
+
+
+def test_registry_to_dense_matches_incidence():
+    reg = nationwide_registry(n=300, seed=5)
+    dense, space = reg.to_dense()
+    A = np.asarray(dense.A)
+    assert A.shape == (reg.n, sum(len(f) for f in reg.features))
+    # every agent occupies exactly one cell per category
+    assert np.all(A.sum(axis=1) == reg.n_categories)
+    assert np.array_equal(A, reg.incidence())
+    assert len(space.cells) == A.shape[1]
+    inst = nationwide_registry(n=40, seed=5).to_instance()
+    assert len(inst.agents) == 40 and inst.k >= 1
+
+
+# --- runtime topology --------------------------------------------------------
+
+
+def test_topology_shapes_and_degradation():
+    for nd in (1, 2, 4, 8):
+        topo = dist_runtime.build_topology(nd)
+        assert topo.n_devices == nd
+        assert topo.mesh.axis_names == CHAIN_AXES
+        assert topo.shape == {AXIS_CHAINS: nd, AXIS_AGENTS: 1}
+    topo = dist_runtime.build_topology(8, agents_axis=2)
+    assert topo.shape == {AXIS_CHAINS: 4, AXIS_AGENTS: 2}
+    with pytest.raises(ValueError):
+        dist_runtime.build_topology(6, agents_axis=4)
+
+
+def test_default_topology_is_cached_and_backs_default_mesh():
+    t1 = dist_runtime.default_topology()
+    t2 = dist_runtime.default_topology()
+    assert t1 is t2
+    assert default_mesh() is t1.mesh
+
+
+def test_bootstrap_single_process_fallback():
+    info = dist_runtime.bootstrap()
+    assert info.process_count == 1 and info.process_index == 0
+    assert not info.initialized and info.coordinator == ""
+    # idempotent: second call returns the cached outcome
+    assert dist_runtime.bootstrap() is info
+
+
+def test_effective_mesh_gate():
+    cfg = default_config()
+    log = RunLog(echo=False)
+    mesh = dist_runtime.effective_mesh(cfg, log=log)
+    assert mesh is not None and int(mesh.devices.size) == len(jax.devices())
+    assert log.counters.get("dist_mesh_devices") == len(jax.devices())
+    # the mesh_to_single_device rung: dist_mesh=False forces the
+    # undistributed path
+    assert dist_runtime.effective_mesh(cfg.replace(dist_mesh=False)) is None
+
+
+def test_mesh_to_single_device_rung_registered():
+    from citizensassemblies_tpu.robust.policy import DEGRADATION_LADDER
+
+    names = [name for name, _ in DEGRADATION_LADDER]
+    assert names[-1] == "mesh_to_single_device"
+    gates = dict(DEGRADATION_LADDER)["mesh_to_single_device"]
+    assert gates == {"dist_mesh": False}
+
+
+def test_process_slice_single_and_simulated_multi():
+    # single process: the slice is the whole range (bit-identity anchor)
+    assert dist_runtime.process_slice(7) == (0, 7)
+    assert dist_runtime.process_slice(0) == (0, 0)
+    # simulated 3-host topology: this process (index 0) takes the first
+    # ceil-balanced block
+    topo = Topology(
+        mesh=make_mesh(1), hosts=3, devices_per_host=1, agents_axis=1
+    )
+    assert dist_runtime.process_slice(7, topo) == (0, 3)
+    assert dist_runtime.process_slice(2, topo) == (0, 1)
+
+
+# --- pre-partitioned feeding -------------------------------------------------
+
+
+def test_prepartition_counts_and_steady_state():
+    # 4×2 mesh so chain_batch (axis 0 over all 8 devices) and chain_rows
+    # (axis 0 over the 4 chains rows only) are genuinely different layouts
+    mesh = make_mesh(8, agents_axis=2)
+    log = RunLog(echo=False)
+    sh = dist_partition.chain_batch(mesh, ndim=2)
+    x = np.ones((16, 4), np.float32)
+    y = dist_partition.prepartition(x, sh, log=log)
+    assert log.counters.get("dist_placements") == 1
+    assert dist_partition.reshard_count(log) == 0
+    # steady state: the placed array passes through untouched
+    y2 = dist_partition.prepartition(y, sh, log=log)
+    assert y2 is y
+    assert dist_partition.reshard_count(log) == 0
+    # a mesh-committed array moved to a DIFFERENT declared spec is the
+    # counted bug class
+    other = dist_partition.chain_rows(mesh, ndim=2)
+    dist_partition.prepartition(y, other, log=log)
+    assert dist_partition.reshard_count(log) == 1
+
+
+def test_spec_cache_declared_once():
+    mesh = make_mesh(8)
+    assert dist_partition.chain_batch(mesh) is dist_partition.chain_batch(mesh)
+    assert dist_partition.portfolio(mesh) is dist_partition.portfolio(mesh)
+    assert dist_partition.bucket(mesh, 3) is dist_partition.bucket(mesh, 3)
+    stats = dist_partition.spec_cache_stats()
+    assert stats is None or stats["size"] >= 1
+
+
+def test_mc_one_device_bit_identity_pin():
+    """The 1-device mesh path must be BIT-identical to the undistributed
+    kernel — the anchor the whole weak-scaling family is certified against
+    — and stay identical at every mesh size (global chain-id keying)."""
+    from citizensassemblies_tpu.models.legacy import _sample_panels_kernel
+    from citizensassemblies_tpu.parallel.mc import distributed_sample_panels
+
+    reg = nationwide_registry(n=300, seed=2)
+    dense, _ = reg.to_dense()
+    key = jax.random.PRNGKey(3)
+    B = 16
+    ref_p, ref_ok = _sample_panels_kernel(dense, key, B)
+    log = RunLog(echo=False)
+    for nd in (1, 2, 8):
+        p, ok = distributed_sample_panels(dense, key, B, make_mesh(nd), log=log)
+        assert np.array_equal(np.asarray(p), np.asarray(ref_p)), nd
+        assert np.array_equal(np.asarray(ok), np.asarray(ref_ok)), nd
+    assert dist_partition.reshard_count(log) == 0
+
+
+def test_batch_lp_prepartition_matches_legacy_layout():
+    from citizensassemblies_tpu.solvers.batch_lp import BatchLP, solve_lp_batch
+
+    rng = np.random.default_rng(4)
+
+    def mk(nv=6, m1=3, m2=2):
+        c = rng.standard_normal(nv)
+        G = np.vstack([rng.standard_normal((m1, nv)), np.eye(nv), -np.eye(nv)])
+        h = np.concatenate(
+            [G[:m1] @ rng.random(nv) + 1.0, np.full(2 * nv, 5.0)]
+        )
+        A = rng.standard_normal((m2, nv))
+        b = A @ rng.random(nv)
+        return BatchLP(c=c, G=G, h=h, A=A, b=b)
+
+    probs = [mk() for _ in range(4)]
+    cfg = default_config()
+    mesh = make_mesh(8)
+    log = RunLog(echo=False)
+    pre = solve_lp_batch(probs, cfg=cfg, log=log, mesh=mesh, defer=False)
+    legacy = solve_lp_batch(
+        probs, cfg=cfg.replace(dist_prepartition=False), mesh=mesh, defer=False
+    )
+    for a, b_ in zip(pre, legacy):
+        assert float(np.max(np.abs(a.x - b_.x))) < 1e-9
+    assert dist_partition.reshard_count(log) == 0
+
+
+def test_dist_collective_fault_walks_ladder():
+    """An armed dist_collective site makes the mesh handout raise; the
+    ladder's last rung (dist_mesh=False) then lands the retry on the
+    single-device path."""
+    from citizensassemblies_tpu.robust.inject import (
+        FaultInjected,
+        FaultInjector,
+        use_injector,
+    )
+    from citizensassemblies_tpu.robust.policy import DegradationLadder
+
+    cfg = default_config()
+    log = RunLog(echo=False)
+    inj = FaultInjector("dist_collective:1.0", seed=0)
+    with pytest.raises(FaultInjected):
+        with use_injector(inj):
+            dist_runtime.effective_mesh(cfg, log=log)
+    ladder = DegradationLadder()
+    while not ladder.exhausted:
+        cfg = ladder.degrade(cfg)
+    assert cfg.dist_mesh is False
+    assert dist_runtime.effective_mesh(cfg) is None

@@ -595,3 +595,87 @@ def test_r9_non_literal_site_flagged(tmp_path):
     viols = [v for v in report.violations if v.rule == "R9"]
     assert viols, render_report(report)
     assert "LITERAL" in viols[0].message
+
+
+# --- R10: mesh hygiene -------------------------------------------------------
+
+
+def test_r10_axis_literal_flagged(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f(x, mesh):\n"
+        "    spec = P('chains', None)\n"
+        "    return jax.lax.psum(x, 'agents')\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R10"]
+    assert len(viols) == 2, render_report(report)
+    assert "hardcoded collective axis name" in viols[0].message
+
+
+def test_r10_constants_and_other_strings_not_flagged(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "AXIS = 'chains'\n"  # plain assignment, not a collective call
+        "def f(x, axis_name):\n"
+        "    spec = P(axis_name, None)\n"
+        "    jax.lax.psum(x, AXIS)\n"
+        "    return some_call('chains')\n"  # not a collective constructor
+    )})
+    assert "R10" not in _rules(report), render_report(report)
+
+
+def test_r10_topology_module_exempt_and_defines_names(tmp_path):
+    # the topology module may spell its own literals, and a renamed axis
+    # retargets the rule (the fixture renames chains -> lanes)
+    report = _lint(tmp_path, {
+        "dist/runtime.py": (
+            "AXIS_CHAINS = 'lanes'\n"
+            "AXIS_AGENTS = 'agents'\n"
+        ),
+        "mod.py": (
+            "import jax\n"
+            "def f(x):\n"
+            "    return jax.lax.psum(x, 'lanes')\n"
+        ),
+        "ok.py": (
+            "import jax\n"
+            "def f(x):\n"
+            "    return jax.lax.psum(x, 'chains')\n"  # no longer an axis name
+        ),
+    })
+    viols = [v for v in report.violations if v.rule == "R10"]
+    assert [v.path for v in viols] == ["mod.py"], render_report(report)
+    assert "'lanes'" in viols[0].message
+
+
+def test_r10_unmemoized_mesh_closure_flagged(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def g(core, mesh):\n"
+        "    fn = jax.shard_map(core, mesh=mesh, in_specs=P(), out_specs=P())\n"
+        "    return fn(1)\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R10"]
+    assert len(viols) == 1, render_report(report)
+    assert "mesh-keyed memo" in viols[0].message
+
+
+def test_r10_memoized_and_factory_closures_allowed(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "_CACHE = {}\n"
+        "def g(core, mesh):\n"
+        "    key = (mesh, 1)\n"
+        "    fn = _CACHE.get(key)\n"
+        "    if fn is None:\n"
+        "        fn = jax.shard_map(core, mesh=mesh, in_specs=P(), out_specs=P())\n"
+        "        _CACHE[key] = fn\n"
+        "    return fn(1)\n"
+        "def factory(core, mesh):\n"
+        "    return jax.shard_map(core, mesh=mesh, in_specs=P(), out_specs=P())\n"
+    )})
+    assert "R10" not in _rules(report), render_report(report)
