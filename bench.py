@@ -139,6 +139,34 @@ def _sparse_stamp(timers: dict, counters: dict):
     return out or None
 
 
+def _megakernel_stamp(counters: dict, cfg=None):
+    """Per-row fused-PDHG evidence (kernels/pdhg_megakernel): the resolved
+    gate state for THIS environment (engaged / interpret / off), how many
+    fused dispatches the row's solves actually made and how many lanes they
+    fused, plus the VMEM fit budget the auto gate checks shapes against.
+    mode "off" with zero dispatches is the honest CPU-CI row — the auto
+    gate only engages on a real accelerator."""
+    import jax
+
+    from citizensassemblies_tpu.utils.config import default_config
+
+    cfg = cfg or default_config()
+    gate = cfg.pdhg_megakernel
+    on_tpu = jax.default_backend() == "tpu"
+    if gate is False:
+        mode = "off"
+    elif gate is None:
+        mode = "engaged" if on_tpu else "off"
+    else:
+        mode = "engaged" if on_tpu else "interpret"
+    return {
+        "mode": mode,
+        "dispatches": int(counters.get("megakernel_dispatches", 0)),
+        "lanes_fused": int(counters.get("megakernel_lanes", 0)),
+        "vmem_budget_mb": int(cfg.pdhg_megakernel_vmem_mb),
+    }
+
+
 BASELINES = {
     # reference golden median LEXIMIN runtimes (BASELINE.md)
     "example_large_200_like": 1161.8,
@@ -151,22 +179,23 @@ BASELINES = {
 
 
 def _sampler_throughput(dense, batch: int = 4096, reps: int = 5):
-    """Measure the LEGACY sampler's panels/s for the scan and (on TPU) the
-    opt-in Pallas kernel — the measurement behind the kernel's demotion
-    (VERDICT r2 item #4): at reference shapes the two are within ±6 %, so
-    the fused kernel's HBM-traffic savings don't reach the wall-clock.
+    """Measure the LEGACY scan sampler's panels/s. The former Pallas
+    sampler row is gone with the kernel (PR 14 verdict: across five bench
+    rounds it never decisively beat the scan path — 11.9k vs 11.2k
+    panels/s at the reference shape in BENCH_r05, inside the
+    round-to-round variance band below).
     Results are forced to host (``np.asarray``): through a TPU tunnel,
     ``block_until_ready`` alone does not actually drain the pipeline and
     overstated throughput ~1000×.
 
-    Each sampler reports a ``{median, min, max, reps}`` BAND, not a point
+    The sampler reports a ``{median, min, max, reps}`` BAND, not a point
     (VERDICT r4 #4): the r3→r4 point numbers (scan 18008 → 6864) implied a
     2.6× regression, but no sampler code changed between the rounds
     (``git diff cd4e24e eb869c3`` touches only bench.py) and three fresh
-    isolated sessions measured 13.7k–15.7k scan / 14.8k–16.0k pallas —
-    the r4 number was a tunnel/device-load artifact of measuring at the
-    tail of the full bench. The band makes that variance visible per run
-    instead of recording one draw from it as "the" throughput."""
+    isolated sessions measured 13.7k–15.7k scan — the r4 number was a
+    tunnel/device-load artifact of measuring at the tail of the full
+    bench. The band makes that variance visible per run instead of
+    recording one draw from it as "the" throughput."""
     import jax
     import numpy as np
 
@@ -174,11 +203,6 @@ def _sampler_throughput(dense, batch: int = 4096, reps: int = 5):
 
     out = {}
     samplers = ["scan"]
-    if jax.default_backend() == "tpu":
-        from citizensassemblies_tpu.kernels.sampler import block_for_dense
-
-        if block_for_dense(dense) > 0:
-            samplers.append("pallas")
     key = jax.random.PRNGKey(0)
     for s in samplers:
         panels, ok = sample_panels_batch(dense, key, batch, sampler=s, distribute=False)
@@ -425,6 +449,9 @@ def main() -> None:
                 )
                 if sparse_row:
                     detail[key]["sparse"] = sparse_row
+                detail[key]["megakernel"] = _megakernel_stamp(
+                    runs[len(runs) // 2][2]
+                )
                 sync_row = _host_sync_stamp(runs[len(runs) // 2][2])
                 if sync_row:
                     detail[key]["decomp_host_syncs"] = sync_row
@@ -590,6 +617,9 @@ def main() -> None:
         xmin_sparse = _sparse_stamp(xlog.timers, dict(xlog.counters))
         if xmin_sparse:
             detail["xmin_sf_e_skewed"]["sparse"] = xmin_sparse
+        detail["xmin_sf_e_skewed"]["megakernel"] = _megakernel_stamp(
+            dict(xlog.counters)
+        )
         xmin_sync = _host_sync_stamp(dict(xlog.counters))
         if xmin_sync:
             detail["xmin_sf_e_skewed"]["decomp_host_syncs"] = xmin_sync
@@ -675,6 +705,7 @@ def main() -> None:
             hh_sparse = _sparse_stamp(hlog.timers, hlog.counters)
             if hh_sparse:
                 detail[tag]["sparse"] = hh_sparse
+            detail[tag]["megakernel"] = _megakernel_stamp(dict(hlog.counters))
             hh_sync = _host_sync_stamp(hlog.counters)
             if hh_sync:
                 detail[tag]["decomp_host_syncs"] = hh_sync
@@ -920,6 +951,52 @@ def smoke() -> int:
             f"sparse master parity: ELL eps {eps_e:.2e} vs dense {eps_d:.2e}"
         )
 
+    # --- megakernel parity (kernels/pdhg_megakernel) -----------------------
+    # the SAME master once more through the fused Pallas iterate (interpret
+    # mode on CPU CI, the compiled Mosaic kernel on a real accelerator):
+    # chained-vs-fused x within the 1e-3 L∞ contract and the fused solve
+    # certifying the same ε. The warm-compile bound below is asserted with
+    # the DEFAULT gate (None ⇒ chained on CPU), so the fused path cannot
+    # perturb the bound it rides under.
+    from citizensassemblies_tpu.kernels import pdhg_megakernel as _mkmod
+
+    mk_cfg = cfg.replace(pdhg_megakernel=True)
+    mk_vmem = _mkmod.two_sided_vmem_bytes(T, 128, int(ell_full.k_pad))
+    mk_mode = _mkmod.megakernel_mode(mk_cfg, mk_vmem)
+    t_mk = time.time()
+    sol_mk = solve_two_sided_master_ell(
+        ell_full, v_prof, cfg=mk_cfg, max_iters=20_000
+    )
+    mk_seconds = time.time() - t_mk
+    mk_parity = float(
+        np.abs(np.asarray(sol_mk.x) - np.asarray(sol_ell.x)).max()
+    )
+    pm_ = np.maximum(sol_mk.x[:C], 0.0)
+    pm_ = pm_ / pm_.sum()
+    eps_m = float(np.abs(MT @ pm_ - v_prof).max())
+    if mk_mode == "off":
+        failures.append(
+            f"megakernel gate resolved 'off' for the smoke shape "
+            f"(vmem {mk_vmem} bytes) — the parity check is vacuous"
+        )
+    if mk_parity > 1e-3:
+        failures.append(
+            f"megakernel chained-vs-fused x L∞ {mk_parity:.2e} > 1e-3"
+        )
+    if eps_m > max(2 * eps_d, 1e-4):
+        failures.append(
+            f"megakernel master parity: fused eps {eps_m:.2e} vs dense "
+            f"{eps_d:.2e}"
+        )
+    mk_stamp = {
+        "mode": mk_mode,
+        "parity_linf": round(mk_parity, 9),
+        "eps_fused": round(eps_m, 9),
+        "seconds": round(mk_seconds, 2),
+        "lanes": 1,
+        "vmem_bytes": int(mk_vmem),
+    }
+
     # --- device-pricing host-sync invariants (solvers/device_pricing) ------
     # the same tiny face decomposition run twice through the forced device-
     # master route: once with the host anchor MILPs (gate off) and once with
@@ -1073,6 +1150,7 @@ def smoke() -> int:
                 "seconds": round(time.time() - t_start, 1),
                 "parity_linf": round(parity, 9),
                 "sparse_parity_eps": round(sparse_parity, 9),
+                "megakernel": mk_stamp,
                 "device_pricing": {
                     "host_syncs_host_oracle": sync_host,
                     "host_syncs_device": sync_dev,
@@ -1096,6 +1174,142 @@ def smoke() -> int:
             }
         )
     )
+    return 1 if failures else 0
+
+
+def kernels_bench(smoke_mode: bool = False) -> int:
+    """``--kernels``: the kernel-family microbench — PDHG block-iteration
+    throughput chained vs fused at the three hot shapes (flagship master,
+    household-quotient master, the batched polish screen) plus the scan
+    sampler's panels/s band, written as a ``BENCH_kernels_rNN.json``
+    artifact in the BENCH_detail row schema so ``obs/trend.py`` folds the
+    kernel family into the regression gate.
+
+    On CPU the fused rows run the INTERPRET-mode kernel: they are
+    correctness trajectories with honest interpreter wall times, not
+    hardware numbers — the per-row megakernel stamp records which regime
+    produced them, and every chained/fused pair is held to the 1e-3 L∞
+    exactness contract regardless of regime."""
+    import numpy as np
+
+    from citizensassemblies_tpu.core.generator import random_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.solvers.batch_lp import solve_polish_screen_ell
+    from citizensassemblies_tpu.solvers.lp_pdhg import solve_two_sided_master_ell
+    from citizensassemblies_tpu.solvers.sparse_ops import EllPack
+    from citizensassemblies_tpu.utils.config import default_config
+
+    t_start = time.time()
+    iters = 256 if smoke_mode else 2048
+    reps = 2 if smoke_mode else 3
+    failures = []
+    detail = {}
+
+    def _master_fixture(seed, T, C, density, scale):
+        r = np.random.default_rng(seed)
+        comps = (r.random((C, T)) < density) * r.integers(1, 4, (C, T))
+        MT = (comps / scale).T.astype(np.float64)
+        v = MT @ np.full(C, 1.0 / C)
+        return EllPack.from_rows(np.asarray(MT, np.float32).T, minor=T), v
+
+    # hot shapes 1+2: the serial two-sided masters (flagship-composition
+    # and household-quotient aspect ratios). tol=1e-12 pins the iteration
+    # count to max_iters so the rows measure block throughput, not the
+    # (shape-dependent) convergence point.
+    for tag, (ell, v) in (
+        ("T24_C96", _master_fixture(7, 24, 96, 0.2, 8.0)),
+        ("T40_C64", _master_fixture(11, 40, 64, 0.12, 4.0)),
+    ):
+        xs = {}
+        for path, gate in (("chained", False), ("fused", True)):
+            cfg = default_config().replace(pdhg_megakernel=gate)
+            solve_two_sided_master_ell(
+                ell, v, cfg=cfg, tol=1e-12, max_iters=iters
+            )  # warm the bucket executable out of the timed reps
+            times, sol = [], None
+            for _ in range(reps):
+                t0 = time.time()
+                sol = solve_two_sided_master_ell(
+                    ell, v, cfg=cfg, tol=1e-12, max_iters=iters
+                )
+                times.append(time.time() - t0)
+            times.sort()
+            med = times[len(times) // 2]
+            xs[path] = np.asarray(sol.x)
+            detail[f"kernel_master_{path}_{tag}"] = {
+                "seconds": round(med, 3),
+                "iters": int(sol.iters),
+                "iters_per_s": round(int(sol.iters) / max(med, 1e-9)),
+                "megakernel": _megakernel_stamp({}, cfg),
+            }
+        pair_linf = float(np.abs(xs["chained"] - xs["fused"]).max())
+        detail[f"kernel_master_fused_{tag}"]["pair_linf"] = round(pair_linf, 9)
+        if pair_linf > 1e-3:
+            failures.append(
+                f"kernel row {tag}: chained-vs-fused x L∞ {pair_linf:.2e} > 1e-3"
+            )
+
+    # hot shape 3: the batched polish screen (one dispatch, 3 real lanes on
+    # a B=4 grid — the megakernel's lane-fusion case)
+    ell_b, v_b = _master_fixture(7, 24, 96, 0.2, 8.0)
+    caps = [96, 48, 24]
+    for path, gate in (("chained", False), ("fused", True)):
+        cfg = default_config().replace(pdhg_megakernel=gate)
+        solve_polish_screen_ell(
+            ell_b, v_b, caps, [None] * 3, 1e-12, iters, cfg=cfg
+        )
+        times, sols = [], None
+        for _ in range(reps):
+            t0 = time.time()
+            sols = solve_polish_screen_ell(
+                ell_b, v_b, caps, [None] * 3, 1e-12, iters, cfg=cfg
+            )
+            times.append(time.time() - t0)
+        times.sort()
+        med = times[len(times) // 2]
+        lane_iters = sum(int(s.iters) for s in sols)
+        detail[f"kernel_screen_{path}_T24_C96_B4"] = {
+            "seconds": round(med, 3),
+            "lanes": len(caps),
+            "lane_iters": lane_iters,
+            "iters_per_s": round(lane_iters / max(med, 1e-9)),
+            "megakernel": _megakernel_stamp({}, cfg),
+        }
+
+    # sampler row: the scan sampler's panels/s band (the Pallas sampler row
+    # ended with the kernel — PR 14 verdict, see README "Pallas verdicts")
+    thr_dense, _ = featurize(
+        random_instance(n=200, k=24, n_categories=4, seed=3)
+    )
+    band = _sampler_throughput(
+        thr_dense, batch=512 if smoke_mode else 4096, reps=max(reps, 3)
+    )["scan"]
+    detail["kernel_sampler_scan"] = {
+        # the trend gate tracks seconds; panels/s is the human-facing band
+        "seconds": round(
+            (512 if smoke_mode else 4096) / max(band["median"], 1e-9), 4
+        ),
+        "panels_per_s": band,
+    }
+
+    doc = {
+        "schema_version": 1,
+        "kernels_ok": not failures,
+        "seconds": round(time.time() - t_start, 1),
+        "backend": __import__("jax").default_backend(),
+        "smoke": bool(smoke_mode),
+        "iters_per_row": iters,
+        "detail": detail,
+        "failures": failures,
+    }
+    print(json.dumps(doc))
+    out_path = os.environ.get("BENCH_KERNELS_PATH")
+    if out_path:
+        try:
+            with open(out_path, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1)
+        except OSError:
+            pass
     return 1 if failures else 0
 
 
@@ -2188,6 +2402,8 @@ if __name__ == "__main__":
         if os.environ.get("BENCH_DIST_CHILD"):
             raise SystemExit(dist_bench_child(smoke_mode="--smoke" in sys.argv))
         raise SystemExit(dist_bench(smoke_mode="--smoke" in sys.argv))
+    if "--kernels" in sys.argv:
+        raise SystemExit(kernels_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         raise SystemExit(smoke())
     main()

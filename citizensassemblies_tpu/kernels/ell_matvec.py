@@ -1,11 +1,11 @@
 """Pallas TPU kernel for the ELL gather matvec (opt-in).
 
-STATUS — opt-in, like the fused sampler kernel (``kernels/sampler.py``): the
-XLA lowering of the ELL matvec pair (``solvers/sparse_ops``) is already a
-fused gather + reduction, so this kernel exists as the packaged example of
-keeping the packed operator VMEM-resident across a grid of column blocks —
-the layout a multi-matvec fusion (a whole PDHG block step in one kernel)
-would build on — not as the default dispatch path.
+STATUS — opt-in: the XLA lowering of the ELL matvec pair
+(``solvers/sparse_ops``) is already a fused gather + reduction, so this
+kernel exists as the packaged example of keeping the packed operator
+VMEM-resident across a grid of column blocks — the layout the PDHG
+megakernel (``kernels/pdhg_megakernel.py``) builds on for the full fused
+block step — not as the default dispatch path.
 
 Shape contract: the packed ``indices[C, k_pad]`` / ``values[C, k_pad]``
 arrays are tiled over a 1-D grid of column blocks; each program holds its
@@ -42,8 +42,8 @@ def _round_up(x: int, m: int) -> int:
 def _ell_gather_kernel(idx_ref, val_ref, y_ref, out_ref):
     """One column block: gather the packed slots from the VMEM-resident
     ``y`` row and reduce over the slot axis. Output is a [block_c, 128]
-    tile with column 0 meaningful (the lane-padded scalar idiom of
-    ``kernels/sampler.py``)."""
+    tile with column 0 meaningful (the lane-padded scalar idiom shared
+    with ``kernels/pdhg_megakernel.py``)."""
     idx = idx_ref[:]  # [block_c, k_pad] int32
     val = val_ref[:]  # [block_c, k_pad] f32
     y = y_ref[0, :]  # [minor_pad] f32

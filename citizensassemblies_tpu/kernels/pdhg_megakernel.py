@@ -1,0 +1,1066 @@
+"""Fused Pallas PDHG megakernel over the VMEM-resident ELL cores.
+
+The chained PDHG iterate (``lp_pdhg._two_sided_iterate`` /
+``lp_pdhg._pdhg_body_ell``) is a sequence of small XLA ops — gather matvec,
+prox, scatter matvec, dual prox — each of which round-trips x, y and the
+packed ``EllPack`` values through HBM. At flagship shapes (k_pad ≈ 40,
+T ≤ 600, C ≤ a few thousand) the whole working set fits in one core's VMEM,
+so this module fuses an entire PDHG *block* — ``check_every`` inner
+iterations, the KKT check of both the current and the averaged iterate, the
+restart-to-average selection, the ω primal-dual rebalance, and the
+``robust_sentinels`` freeze-at-last-finite-iterate merge — into a single
+``pallas_call``. The outer convergence loop stays a ``lax.while_loop`` whose
+body is one kernel launch, so per solve the operands are read from HBM once
+per block instead of ~12 times per iteration.
+
+Two kernels cover the three hot consumers:
+
+* :func:`dispatch_two_sided` — the two-sided ε master, batched over
+  polish-screen lanes (grid = one program per lane, per-lane convergence
+  masks so early finishers freeze exactly like the vmapped chained core).
+  Serves ``lp_pdhg.solve_two_sided_master[_ell]_async`` (B = 1) and
+  ``batch_lp.solve_polish_screen_ell`` (B = screen lanes).
+* :func:`dispatch_lp` — the generic-form LP (ELL inequality rows + dense
+  equality block), serving ``lp_pdhg.solve_lp_ell``.
+
+Matvec strategy inside the kernel: the adjoint direction stays the true
+packed gather (``jnp.take`` over the ELL indices — the proven
+``kernels/ell_matvec.py`` idiom), while the forward direction multiplies
+against a transposed dense expansion of the scaled pack, built ONCE per
+kernel launch into VMEM by a static loop over the k_pad slots
+(Mosaic has no in-kernel scatter-add; the expansion turns the scatter into
+an MXU row-times-matrix product against data that never leaves VMEM).
+
+The Ruiz equilibration, power-norm ‖K‖ estimate and warm-start scaling run
+in plain JAX *outside* the kernel using the exact op sequence of the chained
+ELL bodies, so fused-vs-chained differences reduce to matvec op order —
+interpret-mode parity is ε-level, and the gate-off path is bit-identical
+because it never enters this module.
+
+Gating is the tri-state ``Config.pdhg_megakernel``: ``None`` = auto (real
+accelerator backends only, and only when the estimated VMEM working set
+fits ``Config.pdhg_megakernel_vmem_mb``); ``True`` forces the fused path
+(interpret mode off-TPU — the CPU test path); ``False`` = off.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.obs.hooks import dispatch_span
+from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.guards import no_implicit_transfers
+
+__all__ = [
+    "megakernel_mode",
+    "two_sided_vmem_bytes",
+    "lp_vmem_bytes",
+    "dispatch_two_sided",
+    "dispatch_lp",
+    "two_sided_megakernel_core",
+    "lp_megakernel_core",
+]
+
+_LANE = 128  # TPU lane width: minor dims and the scalar row pad to this
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# --- VMEM working-set estimates + the tri-state gate -------------------------
+
+def two_sided_vmem_bytes(T: int, C: int, k_pad: int) -> int:
+    """Per-lane VMEM bytes of the two-sided block kernel: the transposed
+    dense expansion dominates; pack (idx + values), the per-lane state/operand
+    rows and the scalar row ride along."""
+    Cp, Tp = _round_up(max(C, 1), _LANE), _round_up(max(T, 1), _LANE)
+    st = Cp * Tp * 4  # transposed expansion of the scaled pack
+    pack = 2 * Cp * k_pad * 4  # idx (i32) + scaled values (f32)
+    rows = 4 * (4 * Cp + 10 * Tp + _LANE)  # state + operand rows + scalars
+    return st + pack + rows
+
+
+def lp_vmem_bytes(m1: int, nv: int, k_pad: int, m2: int) -> int:
+    """VMEM bytes of the generic-form kernel (dense expansion of the ELL
+    inequality rows + the resident dense equality block)."""
+    m1p, nvp = _round_up(max(m1, 1), _LANE), _round_up(max(nv, 1), _LANE)
+    m2p = _round_up(max(m2, 1), 8)
+    gd = m1p * nvp * 4
+    pack = 2 * m1p * k_pad * 4
+    dense_a = m2p * nvp * 4
+    rows = 4 * (4 * nvp + 4 * m1p + 4 * m2p + _LANE)
+    return gd + pack + dense_a + rows
+
+
+def megakernel_mode(cfg: Optional[Config], vmem_bytes: int) -> str:
+    """Resolve the tri-state gate to ``"engaged"`` (compiled Mosaic kernel),
+    ``"interpret"`` (forced on a non-TPU backend — the CPU test path) or
+    ``"off"``. The VMEM fit check applies in every mode: a kernel instance
+    that cannot hold its expansion on-chip falls back to the chained cores
+    rather than compiling a spilling kernel."""
+    cfg = cfg or default_config()
+    gate = cfg.pdhg_megakernel
+    if gate is False:
+        return "off"
+    if vmem_bytes > int(cfg.pdhg_megakernel_vmem_mb) * 1024 * 1024:
+        return "off"
+    on_tpu = jax.default_backend() == "tpu"
+    if gate is None:
+        return "engaged" if on_tpu else "off"
+    return "engaged" if on_tpu else "interpret"
+
+
+# --- scalar-row layout -------------------------------------------------------
+# Per-lane scalars travel through the kernel packed into one [B, 128] f32 row
+# (column 0-style lane padding, like the ell_matvec output). Flags are split
+# into separate 0/1 poisoned/stalled columns so the kernel never needs f32
+# bit arithmetic; it/since are exact in f32 at their ranges (≤ max_iters ≪
+# 2^24). Columns ≥ _SC_N are dead padding.
+_SC_EPS = 0      # two-sided: scaled ε iterate
+_SC_MU = 1       # two-sided: scaled μ iterate
+_SC_EAV = 2      # two-sided: averaged ε
+_SC_MAV = 3      # two-sided: averaged μ
+_SC_IT = 4       # iterations completed
+_SC_RES = 5      # last KKT residual (inf until the first check)
+_SC_OMEGA = 6    # primal-dual balance ω
+_SC_POIS = 7     # sentinel: non-finite residual seen (lane quarantined)
+_SC_STALL = 8    # sentinel: ≥ _STALL_BLOCKS checks without improvement
+_SC_BEST = 9     # sentinel: best finite residual so far
+_SC_SINCE = 10   # sentinel: checks since the best improved
+_SC_BS = 11      # scaled b (two-sided: the Σp row datum)
+_SC_CEPS = 12    # scaled ε objective coefficient
+_SC_NORM = 13    # power-iteration ‖K‖ estimate
+_SC_TOL = 14     # per-lane tolerance
+_SC_SCALE = 15   # KKT normalization scale
+_SC_N = 16
+
+_STALL_BLOCKS = 64  # mirrors lp_pdhg._STALL_BLOCKS
+
+
+def _pack_scal_row(vals: dict, like=None) -> jnp.ndarray:
+    """Build a [1, 128] scalar row inside the kernel from column → value."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1)
+    out = jnp.zeros((1, _LANE), jnp.float32) if like is None else like
+    for col, v in vals.items():
+        out = jnp.where(lane == col, v, out)
+    return out
+
+
+# --- the two-sided block kernel ---------------------------------------------
+
+def _two_sided_block_kernel(
+    idx_ref, vs_ref, ecol_ref, arow_ref, hlo_ref, hup_ref,
+    p_ref, llo_ref, lup_ref, pav_ref, llav_ref, luav_ref, scal_ref,
+    op_ref, ollo_ref, olup_ref, opav_ref, ollav_ref, oluav_ref, oscal_ref,
+    *, check_every: int, max_iters: int, sentinel: bool,
+):
+    """One PDHG block for one polish-screen lane: ``check_every`` fused
+    iterations + KKT/restart/ω + the sentinel merge, all VMEM-resident.
+
+    Mirrors ``lp_pdhg._two_sided_iterate.block`` (and ``_sentinel_while``'s
+    merge) op-for-op; only the matvec implementations differ. A lane whose
+    convergence mask is already clear copies its inputs through unchanged —
+    the same freeze the vmapped chained ``while_loop`` applies to early
+    finishers.
+    """
+    idx = idx_ref[...]                       # [Cp, kp] i32 (shared)
+    vs = vs_ref[0]                           # [Cp, kp] scaled pack values
+    ecol = ecol_ref[...]                     # [1, Tp] scaled ε column
+    arow = arow_ref[...]                     # [1, Cp] scaled Σp row
+    hlo = hlo_ref[...]                       # [1, Tp]
+    hup = hup_ref[...]                       # [1, Tp]
+    p_in = p_ref[...]                        # [1, Cp]
+    llo_in = llo_ref[...]                    # [1, Tp]
+    lup_in = lup_ref[...]                    # [1, Tp]
+    pav_in = pav_ref[...]
+    llav_in = llav_ref[...]
+    luav_in = luav_ref[...]
+    s = scal_ref[0, :]                       # [128]
+
+    eps_in, mu_in = s[_SC_EPS], s[_SC_MU]
+    eav_in, mav_in = s[_SC_EAV], s[_SC_MAV]
+    it_in, res_in, omega = s[_SC_IT], s[_SC_RES], s[_SC_OMEGA]
+    pois_in, stall_in = s[_SC_POIS], s[_SC_STALL]
+    best_in, since_in = s[_SC_BEST], s[_SC_SINCE]
+    bs, cs_eps = s[_SC_BS], s[_SC_CEPS]
+    norm, tol, scale = s[_SC_NORM], s[_SC_TOL], s[_SC_SCALE]
+
+    Cp, kp = vs.shape
+    Tp = ecol.shape[1]
+
+    # the lane's convergence mask — identical to the chained per-lane cond
+    # (non-finite res compares False, so a poisoned non-sentinel lane also
+    # freezes here, exactly like the vmapped while_loop)
+    active = (res_in > tol) & (it_in < float(max_iters)) & (pois_in == 0.0)
+
+    # transposed dense expansion of the scaled pack, built once per launch:
+    # st[c, t] = Σ_slots vs[c, s]·[idx[c, s] == t]. Padding slots carry
+    # value 0 so they land inertly wherever their index points.
+    iota_t = jax.lax.broadcasted_iota(jnp.int32, (Cp, Tp), 1)
+    st = jnp.zeros((Cp, Tp), jnp.float32)
+    for sl in range(kp):
+        st = st + jnp.where(idx[:, sl:sl + 1] == iota_t, vs[:, sl:sl + 1], 0.0)
+
+    def fwd(p_row, eps):
+        """K @ x: forward matvec against the VMEM-resident expansion."""
+        u = jnp.dot(p_row, st, preferred_element_type=jnp.float32)  # [1, Tp]
+        r_lo = -u - ecol * eps
+        r_up = u - ecol * eps
+        r_eq = jnp.sum(arow * p_row)
+        return r_lo, r_up, r_eq
+
+    def adj(llo, lup, mu):
+        """Kᵀ y: the true packed ELL gather (ell_matvec idiom)."""
+        y = (lup - llo)[0]                                   # [Tp]
+        g = jnp.sum(vs * jnp.take(y, idx, axis=0), axis=1)   # [Cp]
+        g_p = g.reshape(1, Cp) + mu * arow
+        g_e = -jnp.sum(ecol * (llo + lup))
+        return g_p, g_e
+
+    tau = 0.9 * omega / norm
+    sigma = 0.9 / (omega * norm)
+
+    def one_iter(_, carry):
+        p, eps, llo, lup, mu, ps, es, lls, lus, ms = carry
+        g_p, g_e = adj(llo, lup, mu)
+        p_new = jnp.maximum(p - tau * g_p, 0.0)
+        eps_new = jnp.maximum(eps - tau * (g_e + cs_eps), 0.0)
+        pb = 2.0 * p_new - p
+        eb = 2.0 * eps_new - eps
+        r_lo, r_up, r_eq = fwd(pb, eb)
+        llo_new = jnp.maximum(llo + sigma * (r_lo - hlo), 0.0)
+        lup_new = jnp.maximum(lup + sigma * (r_up - hup), 0.0)
+        mu_new = mu + sigma * (r_eq - bs)
+        return (
+            p_new, eps_new, llo_new, lup_new, mu_new,
+            ps + p_new, es + eps_new, lls + llo_new, lus + lup_new,
+            ms + mu_new,
+        )
+
+    zero_p = jnp.zeros_like(p_in)
+    zero_t = jnp.zeros_like(llo_in)
+    (p, eps, llo, lup, mu, ps, es, lls, lus, ms) = jax.lax.fori_loop(
+        0, check_every, one_iter,
+        (p_in, eps_in, llo_in, lup_in, mu_in,
+         zero_p, jnp.float32(0.0), zero_t, zero_t, jnp.float32(0.0)),
+    )
+
+    def kkt(p, eps, llo, lup, mu):
+        r_lo, r_up, r_eq = fwd(p, eps)
+        pri = jnp.sqrt(
+            jnp.sum(jnp.maximum(r_lo - hlo, 0.0) ** 2)
+            + jnp.sum(jnp.maximum(r_up - hup, 0.0) ** 2)
+            + (r_eq - bs) ** 2
+        )
+        g_p, g_e = adj(llo, lup, mu)
+        dua = jnp.sqrt(
+            jnp.sum(jnp.minimum(g_p, 0.0) ** 2)
+            + jnp.minimum(g_e + cs_eps, 0.0) ** 2
+        )
+        pobj = cs_eps * eps
+        dobj = -jnp.sum(llo * hlo) - jnp.sum(lup * hup) - mu * bs
+        gap = jnp.abs(pobj - dobj)
+        return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+
+    inv = 1.0 / check_every
+    pa = (pav_in + ps * inv) * 0.5
+    ea = (eav_in + es * inv) * 0.5
+    lla = (llav_in + lls * inv) * 0.5
+    lua = (luav_in + lus * inv) * 0.5
+    ma = (mav_in + ms * inv) * 0.5
+    r_cur = kkt(p, eps, llo, lup, mu)
+    r_avg = kkt(pa, ea, lla, lua, ma)
+    better = r_avg < r_cur
+    p = jnp.where(better, pa, p)
+    eps = jnp.where(better, ea, eps)
+    llo = jnp.where(better, lla, llo)
+    lup = jnp.where(better, lua, lup)
+    mu = jnp.where(better, ma, mu)
+    res = jnp.minimum(r_cur, r_avg)
+    dx = jnp.sqrt(jnp.sum((p - p_in) ** 2))
+    dy = jnp.sqrt(
+        jnp.sum((llo - llo_in) ** 2)
+        + jnp.sum((lup - lup_in) ** 2)
+        + (mu - mu_in) ** 2
+    )
+    moved = (dx > 1e-12) & (dy > 1e-12)
+    omega_new = jnp.sqrt(omega * jnp.clip(dy / jnp.maximum(dx, 1e-12), 1e-4, 1e4))
+    omega_out = jnp.where(moved, jnp.clip(omega_new, 1.0 / 64.0, 64.0), omega)
+    it_out = it_in + float(check_every)
+
+    pois, stall, best, since = pois_in, stall_in, best_in, since_in
+    if sentinel:
+        # _sentinel_while's merge: a non-finite residual reverts the WHOLE
+        # carry (iterates, averages, it, res, ω) to the last finite block
+        # and quarantines the lane
+        ok = jnp.isfinite(res)
+        p = jnp.where(ok, p, p_in)
+        eps = jnp.where(ok, eps, eps_in)
+        llo = jnp.where(ok, llo, llo_in)
+        lup = jnp.where(ok, lup, lup_in)
+        mu = jnp.where(ok, mu, mu_in)
+        pa = jnp.where(ok, pa, pav_in)
+        ea = jnp.where(ok, ea, eav_in)
+        lla = jnp.where(ok, lla, llav_in)
+        lua = jnp.where(ok, lua, luav_in)
+        ma = jnp.where(ok, ma, mav_in)
+        it_out = jnp.where(ok, it_out, it_in)
+        res = jnp.where(ok, res, res_in)
+        omega_out = jnp.where(ok, omega_out, omega)
+        improved = ok & (res < best_in)
+        best = jnp.where(improved, res, best_in)
+        since = jnp.where(improved, 0.0, since_in + 1.0)
+        pois = jnp.maximum(pois_in, jnp.where(ok, 0.0, 1.0))
+        stall = jnp.maximum(
+            stall_in, jnp.where(since >= float(_STALL_BLOCKS), 1.0, 0.0)
+        )
+
+    def sel(new, old):
+        return jnp.where(active, new, old)
+
+    op_ref[...] = sel(p, p_in)
+    ollo_ref[...] = sel(llo, llo_in)
+    olup_ref[...] = sel(lup, lup_in)
+    opav_ref[...] = sel(pa, pav_in)
+    ollav_ref[...] = sel(lla, llav_in)
+    oluav_ref[...] = sel(lua, luav_in)
+    oscal_ref[...] = _pack_scal_row(
+        {
+            _SC_EPS: sel(eps, eps_in),
+            _SC_MU: sel(mu, mu_in),
+            _SC_EAV: sel(ea, eav_in),
+            _SC_MAV: sel(ma, mav_in),
+            _SC_IT: sel(it_out, it_in),
+            _SC_RES: sel(res, res_in),
+            _SC_OMEGA: sel(omega_out, omega),
+            _SC_POIS: sel(pois, pois_in),
+            _SC_STALL: sel(stall, stall_in),
+            _SC_BEST: sel(best, best_in),
+            _SC_SINCE: sel(since, since_in),
+            _SC_BS: bs,
+            _SC_CEPS: cs_eps,
+            _SC_NORM: norm,
+            _SC_TOL: tol,
+            _SC_SCALE: scale,
+        }
+    )
+
+
+def _two_sided_block_call(
+    idx_p, vs_p, ecol_p, arow_p, hlo_p, hup_p, state,
+    *, check_every: int, max_iters: int, sentinel: bool, interpret: bool,
+):
+    """One launch of the two-sided block kernel over all B lanes."""
+    p, llo, lup, pav, llav, luav, scal = state
+    B, Cp = p.shape
+    Tp = llo.shape[1]
+    kp = idx_p.shape[1]
+    f32 = jnp.float32
+
+    row_c = lambda i: (i, 0)  # noqa: E731 — per-lane row blocks
+    out = pl.pallas_call(
+        partial(
+            _two_sided_block_kernel,
+            check_every=check_every, max_iters=max_iters, sentinel=sentinel,
+        ),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((Cp, kp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, Cp, kp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Cp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Cp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Cp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANE), row_c, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Cp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Cp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp), row_c, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANE), row_c, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Cp), f32),
+            jax.ShapeDtypeStruct((B, Tp), f32),
+            jax.ShapeDtypeStruct((B, Tp), f32),
+            jax.ShapeDtypeStruct((B, Cp), f32),
+            jax.ShapeDtypeStruct((B, Tp), f32),
+            jax.ShapeDtypeStruct((B, Tp), f32),
+            jax.ShapeDtypeStruct((B, _LANE), f32),
+        ],
+        interpret=interpret,
+    )(idx_p, vs_p, ecol_p, arow_p, hlo_p, hup_p, p, llo, lup, pav, llav,
+      luav, scal)
+    return tuple(out)
+
+
+def _mk_two_sided_body(
+    idx, val, v, colmask, x0, lam0, mu0, tol,
+    max_iters: int, check_every: int, sentinel: bool = False,
+    interpret: bool = False,
+):
+    """The fused twin of the vmapped ``lp_pdhg._pdhg_two_sided_body_ell``:
+    same operand layout batched over B lanes (``colmask``/``x0``/``lam0``/
+    ``mu0``/``tol`` lead with the lane axis; the pack is shared), same
+    ``(x, lam, mu, it, res[, flags])`` outputs. The Ruiz/power-norm/warm
+    prelude reuses the chained op sequence verbatim; only the iterate loop
+    runs inside the Pallas block kernel."""
+    from citizensassemblies_tpu.solvers.sparse_ops import (
+        ell_gather_mv,
+        ell_scatter_mv,
+    )
+
+    T = v.shape[0]
+    B, C = colmask.shape
+    f32 = val.dtype
+    absV = jnp.abs(val)
+
+    def prelude(cm, x0_l, lam0_l, mu0_l):
+        # --- Ruiz equilibration: op-for-op _pdhg_two_sided_body_ell ---------
+        def ruiz_body(_, carry):
+            d_r, d_e, d_c, d_eps = carry
+            S = absV * d_r[idx] * d_c[:, None]
+            row_from_cols = jnp.maximum(
+                jax.ops.segment_max(S.ravel(), idx.ravel(), num_segments=T),
+                0.0,
+            )
+            row_ineq = jnp.maximum(row_from_cols, d_r * d_eps)
+            row_eq = jnp.max(d_e * d_c * cm)
+            col = jnp.maximum(S.max(axis=1), d_e * d_c * cm)
+            col_eps = jnp.max(d_r) * d_eps
+            rn = jnp.where(
+                row_ineq > 0, jnp.sqrt(jnp.maximum(row_ineq, 1e-10)), 1.0
+            )
+            ren = jnp.where(row_eq > 0, jnp.sqrt(jnp.maximum(row_eq, 1e-10)), 1.0)
+            cn = jnp.where(col > 0, jnp.sqrt(jnp.maximum(col, 1e-10)), 1.0)
+            cen = jnp.where(
+                col_eps > 0, jnp.sqrt(jnp.maximum(col_eps, 1e-10)), 1.0
+            )
+            return d_r / rn, d_e / ren, d_c / cn, d_eps / cen
+
+        d_r, d_e, d_c, d_eps = jax.lax.fori_loop(
+            0, 8, ruiz_body,
+            (jnp.ones(T, f32), jnp.ones((), f32), jnp.ones(C, f32),
+             jnp.ones((), f32)),
+        )
+        vals_s = val * d_r[idx] * d_c[:, None]
+        e_col = d_r * d_eps
+        a_row = d_e * d_c * cm
+        hs_lo = -v * d_r
+        hs_up = v * d_r
+        bs = 1.0 * d_e
+        cs_eps = 1.0 * d_eps
+
+        def K_apply(p, eps):
+            u = ell_scatter_mv(idx, vals_s, p, T)
+            return -u - e_col * eps, u - e_col * eps, jnp.dot(a_row, p)
+
+        def KT_apply(l_lo, l_up, mu):
+            g_p = ell_gather_mv(idx, vals_s, l_up - l_lo) + mu * a_row
+            g_e = -jnp.dot(e_col, l_lo + l_up)
+            return g_p, g_e
+
+        # --- power iteration: op-for-op _two_sided_iterate ------------------
+        def pow_body(_, vv):
+            p_, e_ = vv
+            r_lo, r_up, r_eq = K_apply(p_, e_)
+            g_p, g_e = KT_apply(r_lo, r_up, r_eq)
+            nrm = jnp.sqrt(jnp.sum(g_p**2) + g_e**2) + 1e-12
+            return g_p / nrm, g_e / nrm
+
+        p0n = jnp.ones(C, dtype=f32) / jnp.sqrt(jnp.float32(C + 1))
+        e0n = jnp.ones((), dtype=f32) / jnp.sqrt(jnp.float32(C + 1))
+        pv, ev = jax.lax.fori_loop(0, 40, pow_body, (p0n, e0n))
+        r_lo, r_up, r_eq = K_apply(pv, ev)
+        g_p, g_e = KT_apply(r_lo, r_up, r_eq)
+        norm = jnp.sqrt(jnp.sqrt(jnp.sum(g_p**2) + g_e**2) + 1e-12)
+        scale = (
+            1.0
+            + jnp.abs(cs_eps)
+            + jnp.sqrt(jnp.sum(hs_lo**2) + jnp.sum(hs_up**2))
+            + jnp.abs(bs)
+        )
+
+        p = x0_l[:C] / jnp.maximum(d_c, 1e-12)
+        eps = x0_l[C] / jnp.maximum(d_eps, 1e-12)
+        l_lo = jnp.maximum(lam0_l[:T] / jnp.maximum(d_r, 1e-12), 0.0)
+        l_up = jnp.maximum(lam0_l[T:] / jnp.maximum(d_r, 1e-12), 0.0)
+        mu = mu0_l / jnp.maximum(d_e, 1e-12)
+        return (
+            vals_s, e_col, a_row, hs_lo, hs_up, bs, cs_eps, norm, scale,
+            p, eps, l_lo, l_up, mu, d_r, d_e, d_c, d_eps,
+        )
+
+    (vals_s, e_col, a_row, hs_lo, hs_up, bs, cs_eps, norm, scale,
+     p, eps, l_lo, l_up, mu, d_r, d_e, d_c, d_eps) = jax.vmap(prelude)(
+        colmask, x0, lam0, mu0
+    )
+
+    # --- pad to lane-aligned kernel shapes (all-zero padding is inert) ------
+    Cp, Tp = _round_up(C, _LANE), _round_up(T, _LANE)
+    pc, pt = Cp - C, Tp - T
+    idx_k = jnp.pad(idx, ((0, pc), (0, 0)))
+    vs_k = jnp.pad(vals_s, ((0, 0), (0, pc), (0, 0)))
+    ecol_k = jnp.pad(e_col, ((0, 0), (0, pt)))
+    arow_k = jnp.pad(a_row, ((0, 0), (0, pc)))
+    hlo_k = jnp.pad(hs_lo, ((0, 0), (0, pt)))
+    hup_k = jnp.pad(hs_up, ((0, 0), (0, pt)))
+    p_k = jnp.pad(p, ((0, 0), (0, pc)))
+    llo_k = jnp.pad(l_lo, ((0, 0), (0, pt)))
+    lup_k = jnp.pad(l_up, ((0, 0), (0, pt)))
+
+    lane = jnp.arange(_LANE)
+    scal0 = jnp.zeros((B, _LANE), jnp.float32)
+    for col, vcol in (
+        (_SC_EPS, eps), (_SC_MU, mu), (_SC_EAV, eps), (_SC_MAV, mu),
+        (_SC_IT, jnp.zeros(B, jnp.float32)),
+        (_SC_RES, jnp.full(B, jnp.inf, jnp.float32)),
+        (_SC_OMEGA, jnp.ones(B, jnp.float32)),
+        (_SC_POIS, jnp.zeros(B, jnp.float32)),
+        (_SC_STALL, jnp.zeros(B, jnp.float32)),
+        (_SC_BEST, jnp.full(B, jnp.inf, jnp.float32)),
+        (_SC_SINCE, jnp.zeros(B, jnp.float32)),
+        (_SC_BS, bs), (_SC_CEPS, cs_eps), (_SC_NORM, norm),
+        (_SC_TOL, tol.astype(jnp.float32)), (_SC_SCALE, scale),
+    ):
+        scal0 = jnp.where(lane[None, :] == col, vcol[:, None], scal0)
+
+    state0 = (p_k, llo_k, lup_k, p_k, llo_k, lup_k, scal0)
+
+    def outer_cond(state):
+        sc = state[6]
+        return jnp.any(
+            (sc[:, _SC_RES] > sc[:, _SC_TOL])
+            & (sc[:, _SC_IT] < float(max_iters))
+            & (sc[:, _SC_POIS] == 0.0)
+        )
+
+    def outer_body(state):
+        return _two_sided_block_call(
+            idx_k, vs_k, ecol_k, arow_k, hlo_k, hup_k, state,
+            check_every=check_every, max_iters=max_iters,
+            sentinel=sentinel, interpret=interpret,
+        )
+
+    p_k, llo_k, lup_k, _, _, _, scal = jax.lax.while_loop(
+        outer_cond, outer_body, state0
+    )
+
+    eps = scal[:, _SC_EPS]
+    mu = scal[:, _SC_MU]
+    it = scal[:, _SC_IT].astype(jnp.int32)
+    res = scal[:, _SC_RES]
+    x_out = jnp.concatenate(
+        [p_k[:, :C] * d_c, (eps * d_eps)[:, None]], axis=1
+    )
+    lam_out = jnp.concatenate(
+        [llo_k[:, :T] * d_r, lup_k[:, :T] * d_r], axis=1
+    )
+    mu_out = mu * d_e
+    if sentinel:
+        flags = (
+            (scal[:, _SC_POIS] > 0.0).astype(jnp.int32)
+            + 2 * (scal[:, _SC_STALL] > 0.0).astype(jnp.int32)
+        )
+        return x_out, lam_out, mu_out, it, res, flags
+    return x_out, lam_out, mu_out, it, res
+
+
+# same donation contract as the chained batched core (x0, lam0; mu0 stays
+# undonated for layout parity with _pdhg_two_sided_core_ell)
+two_sided_megakernel_core = partial(
+    jax.jit,
+    static_argnames=("max_iters", "check_every", "sentinel", "interpret"),
+    donate_argnums=(4, 5),
+)(_mk_two_sided_body)
+
+
+def dispatch_two_sided(
+    operands, *, cfg: Config, log=None, max_iters: int, check_every: int,
+    sentinel: bool, mode: str, lanes: Optional[int] = None,
+):
+    """Span-wrapped launch of the fused two-sided solve. ``operands`` is the
+    batched device tuple ``(idx, val, v, colmask, x0, lam0, mu0, tol)``
+    (lane axis leading on the last five); ``mode`` is the resolved gate
+    state (``"engaged"``/``"interpret"``)."""
+    idx, val = operands[0], operands[1]
+    B, C = operands[3].shape
+    T = operands[2].shape[0]
+    with dispatch_span(
+        "kernels.pdhg_megakernel_two_sided", cfg=cfg, log=log,
+        T=int(T), cols=int(C), k_pad=int(idx.shape[1]),
+        lanes=int(lanes if lanes is not None else B), mode=mode,
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            out = two_sided_megakernel_core(
+                *operands,
+                max_iters=max_iters, check_every=check_every,
+                sentinel=sentinel, interpret=(mode == "interpret"),
+            )
+        _ds.out = out[:5]
+    if log is not None:
+        log.count("megakernel_dispatches")
+        log.count("megakernel_lanes", int(lanes if lanes is not None else B))
+    return out
+
+
+# --- the generic-form LP kernel ---------------------------------------------
+# scalar-row columns for the generic kernel (vector μ lives in its own row)
+_SL_IT = 0
+_SL_RES = 1
+_SL_OMEGA = 2
+_SL_POIS = 3
+_SL_STALL = 4
+_SL_BEST = 5
+_SL_SINCE = 6
+_SL_NORM = 7
+_SL_SCALE = 8
+_SL_TOL = 9
+
+
+def _lp_block_kernel(
+    idx_ref, vs_ref, as_ref, cs_ref, hs_ref, bs_ref,
+    x_ref, lam_ref, mu_ref, xav_ref, lav_ref, mav_ref, scal_ref,
+    ox_ref, olam_ref, omu_ref, oxav_ref, olav_ref, omav_ref, oscal_ref,
+    *, check_every: int, max_iters: int, sentinel: bool,
+):
+    """One PDHG block of the generic LP (``min cᵀx, Gx ≤ h, Ax = b, x ≥ 0``)
+    with G as packed ELL rows — the fused twin of
+    ``lp_pdhg._pdhg_body_ell.block``. ``G @ x`` is the packed row gather;
+    ``Gᵀ λ`` multiplies the dense expansion built once per launch; the small
+    equality block stays a resident dense broadcast-reduce."""
+    idx = idx_ref[...]            # [m1p, kp] i32
+    vs = vs_ref[...]              # [m1p, kp]
+    As = as_ref[...]              # [m2p, nvp]
+    cs = cs_ref[...]              # [1, nvp]
+    hs = hs_ref[...]              # [1, m1p]
+    bs = bs_ref[...]              # [1, m2p]
+    x_in = x_ref[...]             # [1, nvp]
+    lam_in = lam_ref[...]         # [1, m1p]
+    mu_in = mu_ref[...]           # [1, m2p]
+    xav_in = xav_ref[...]
+    lav_in = lav_ref[...]
+    mav_in = mav_ref[...]
+    s = scal_ref[0, :]
+
+    it_in, res_in, omega = s[_SL_IT], s[_SL_RES], s[_SL_OMEGA]
+    pois_in, stall_in = s[_SL_POIS], s[_SL_STALL]
+    best_in, since_in = s[_SL_BEST], s[_SL_SINCE]
+    norm, scale, tol = s[_SL_NORM], s[_SL_SCALE], s[_SL_TOL]
+
+    m1p, kp = vs.shape
+    nvp = cs.shape[1]
+
+    active = (res_in > tol) & (it_in < float(max_iters)) & (pois_in == 0.0)
+
+    # dense expansion of the scaled inequality rows: gd[j, i] = G_s[j, i]
+    iota_v = jax.lax.broadcasted_iota(jnp.int32, (m1p, nvp), 1)
+    gd = jnp.zeros((m1p, nvp), jnp.float32)
+    for sl in range(kp):
+        gd = gd + jnp.where(idx[:, sl:sl + 1] == iota_v, vs[:, sl:sl + 1], 0.0)
+
+    def G_mv(x_row):
+        xv = x_row[0]                                        # [nvp]
+        g = jnp.sum(vs * jnp.take(xv, idx, axis=0), axis=1)  # [m1p]
+        return g.reshape(1, m1p)
+
+    def G_rmv(y_row):
+        return jnp.dot(y_row, gd, preferred_element_type=jnp.float32)
+
+    def A_mv(x_row):
+        return jnp.sum(As * x_row, axis=1).reshape(1, -1)    # [1, m2p]
+
+    def A_rmv(mu_row):
+        return jnp.dot(mu_row, As, preferred_element_type=jnp.float32)
+
+    tau = 0.9 * omega / norm
+    sigma = 0.9 / (omega * norm)
+
+    def one_iter(_, carry):
+        x, lam, mu, xs, ls, ms = carry
+        grad = cs + G_rmv(lam) + A_rmv(mu)
+        x_new = jnp.maximum(x - tau * grad, 0.0)
+        xb = 2.0 * x_new - x
+        lam_new = jnp.maximum(lam + sigma * (G_mv(xb) - hs), 0.0)
+        mu_new = mu + sigma * (A_mv(xb) - bs)
+        return (
+            x_new, lam_new, mu_new, xs + x_new, ls + lam_new, ms + mu_new
+        )
+
+    (x, lam, mu, xs, ls, ms) = jax.lax.fori_loop(
+        0, check_every, one_iter,
+        (x_in, lam_in, mu_in, jnp.zeros_like(x_in), jnp.zeros_like(lam_in),
+         jnp.zeros_like(mu_in)),
+    )
+
+    def kkt(x, lam, mu):
+        pri_ineq = jnp.maximum(G_mv(x) - hs, 0.0)
+        pri_eq = A_mv(x) - bs
+        pri = jnp.sqrt(jnp.sum(pri_ineq**2) + jnp.sum(pri_eq**2))
+        grad = cs + G_rmv(lam) + A_rmv(mu)
+        dua = jnp.sqrt(jnp.sum(jnp.minimum(grad, 0.0) ** 2))
+        pobj = jnp.sum(cs * x)
+        dobj = -jnp.sum(lam * hs) - jnp.sum(mu * bs)
+        gap = jnp.abs(pobj - dobj)
+        return (pri + dua) / scale + gap / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+
+    inv = 1.0 / check_every
+    xa = (xav_in + xs * inv) * 0.5
+    la = (lav_in + ls * inv) * 0.5
+    ma = (mav_in + ms * inv) * 0.5
+    r_cur = kkt(x, lam, mu)
+    r_avg = kkt(xa, la, ma)
+    better = r_avg < r_cur
+    x = jnp.where(better, xa, x)
+    lam = jnp.where(better, la, lam)
+    mu = jnp.where(better, ma, mu)
+    res = jnp.minimum(r_cur, r_avg)
+    dx = jnp.sqrt(jnp.sum((x - x_in) ** 2))
+    dy = jnp.sqrt(jnp.sum((lam - lam_in) ** 2) + jnp.sum((mu - mu_in) ** 2))
+    moved = (dx > 1e-12) & (dy > 1e-12)
+    omega_new = jnp.sqrt(omega * jnp.clip(dy / jnp.maximum(dx, 1e-12), 1e-4, 1e4))
+    omega_out = jnp.where(moved, jnp.clip(omega_new, 1.0 / 64.0, 64.0), omega)
+    it_out = it_in + float(check_every)
+
+    pois, stall, best, since = pois_in, stall_in, best_in, since_in
+    if sentinel:
+        ok = jnp.isfinite(res)
+        x = jnp.where(ok, x, x_in)
+        lam = jnp.where(ok, lam, lam_in)
+        mu = jnp.where(ok, mu, mu_in)
+        xa = jnp.where(ok, xa, xav_in)
+        la = jnp.where(ok, la, lav_in)
+        ma = jnp.where(ok, ma, mav_in)
+        it_out = jnp.where(ok, it_out, it_in)
+        res = jnp.where(ok, res, res_in)
+        omega_out = jnp.where(ok, omega_out, omega)
+        improved = ok & (res < best_in)
+        best = jnp.where(improved, res, best_in)
+        since = jnp.where(improved, 0.0, since_in + 1.0)
+        pois = jnp.maximum(pois_in, jnp.where(ok, 0.0, 1.0))
+        stall = jnp.maximum(
+            stall_in, jnp.where(since >= float(_STALL_BLOCKS), 1.0, 0.0)
+        )
+
+    def sel(new, old):
+        return jnp.where(active, new, old)
+
+    ox_ref[...] = sel(x, x_in)
+    olam_ref[...] = sel(lam, lam_in)
+    omu_ref[...] = sel(mu, mu_in)
+    oxav_ref[...] = sel(xa, xav_in)
+    olav_ref[...] = sel(la, lav_in)
+    omav_ref[...] = sel(ma, mav_in)
+    oscal_ref[...] = _pack_scal_row(
+        {
+            _SL_IT: sel(it_out, it_in),
+            _SL_RES: sel(res, res_in),
+            _SL_OMEGA: sel(omega_out, omega),
+            _SL_POIS: sel(pois, pois_in),
+            _SL_STALL: sel(stall, stall_in),
+            _SL_BEST: sel(best, best_in),
+            _SL_SINCE: sel(since, since_in),
+            _SL_NORM: norm,
+            _SL_SCALE: scale,
+            _SL_TOL: tol,
+        }
+    )
+
+
+def _lp_block_call(
+    idx_p, vs_p, As_p, cs_p, hs_p, bs_p, state,
+    *, check_every: int, max_iters: int, sentinel: bool, interpret: bool,
+):
+    x, lam, mu, xav, lav, mav, scal = state
+    nvp = x.shape[1]
+    m1p = lam.shape[1]
+    m2p = mu.shape[1]
+    kp = idx_p.shape[1]
+    f32 = jnp.float32
+    whole = lambda *shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        partial(
+            _lp_block_kernel,
+            check_every=check_every, max_iters=max_iters, sentinel=sentinel,
+        ),
+        grid=(1,),
+        in_specs=[
+            whole(m1p, kp), whole(m1p, kp), whole(m2p, nvp), whole(1, nvp),
+            whole(1, m1p), whole(1, m2p), whole(1, nvp), whole(1, m1p),
+            whole(1, m2p), whole(1, nvp), whole(1, m1p), whole(1, m2p),
+            whole(1, _LANE),
+        ],
+        out_specs=[
+            whole(1, nvp), whole(1, m1p), whole(1, m2p), whole(1, nvp),
+            whole(1, m1p), whole(1, m2p), whole(1, _LANE),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, nvp), f32),
+            jax.ShapeDtypeStruct((1, m1p), f32),
+            jax.ShapeDtypeStruct((1, m2p), f32),
+            jax.ShapeDtypeStruct((1, nvp), f32),
+            jax.ShapeDtypeStruct((1, m1p), f32),
+            jax.ShapeDtypeStruct((1, m2p), f32),
+            jax.ShapeDtypeStruct((1, _LANE), f32),
+        ],
+        interpret=interpret,
+    )(idx_p, vs_p, As_p, cs_p, hs_p, bs_p, x, lam, mu, xav, lav, mav, scal)
+    return tuple(out)
+
+
+def _mk_lp_body(
+    c, idx, val, h, A, b, x0, lam0, mu0, tol,
+    max_iters: int, check_every: int, sentinel: bool = False,
+    interpret: bool = False,
+):
+    """The fused twin of ``lp_pdhg._pdhg_body_ell``: identical signature,
+    scaling prelude and output layout; the iterate loop runs in the Pallas
+    block kernel."""
+    from citizensassemblies_tpu.solvers.sparse_ops import (
+        ell_gather_mv,
+        ell_scatter_mv,
+    )
+
+    m1 = idx.shape[0]
+    nv = c.shape[0]
+    m2 = A.shape[0]
+    f32 = val.dtype
+    absV = jnp.abs(val)
+    absA = jnp.abs(A)
+
+    # --- Ruiz: op-for-op _pdhg_body_ell -------------------------------------
+    def ruiz_body(_, carry):
+        d_r, d_c = carry
+        Sg = absV * d_r[:m1][:, None] * d_c[idx]
+        Sa = d_r[m1:, None] * absA * d_c[None, :]
+        rmax = jnp.concatenate([Sg.max(axis=1), Sa.max(axis=1)])
+        cmax = jnp.maximum(
+            jnp.maximum(
+                jax.ops.segment_max(Sg.ravel(), idx.ravel(), num_segments=nv),
+                0.0,
+            ),
+            Sa.max(axis=0),
+        )
+        rn = jnp.where(rmax > 0, jnp.sqrt(jnp.maximum(rmax, 1e-10)), 1.0)
+        cn = jnp.where(cmax > 0, jnp.sqrt(jnp.maximum(cmax, 1e-10)), 1.0)
+        return d_r / rn, d_c / cn
+
+    d_r, d_c = jax.lax.fori_loop(
+        0, 8, ruiz_body, (jnp.ones(m1 + m2, f32), jnp.ones(nv, f32))
+    )
+    vals_s = val * d_r[:m1][:, None] * d_c[idx]
+    As = d_r[m1:, None] * A * d_c[None, :]
+    cs = c * d_c
+    hs = h * d_r[:m1]
+    bs = b * d_r[m1:]
+
+    def G_mv(x):
+        return ell_gather_mv(idx, vals_s, x)
+
+    def G_rmv(y):
+        return ell_scatter_mv(idx, vals_s, y, nv)
+
+    def pow_body(_, vv):
+        w = G_rmv(G_mv(vv)) + As.T @ (As @ vv)
+        return w / (jnp.linalg.norm(w) + 1e-12)
+
+    vvec = jax.lax.fori_loop(
+        0, 40, pow_body, jnp.ones(nv, f32) / jnp.sqrt(jnp.float32(nv))
+    )
+    norm = jnp.sqrt(
+        jnp.linalg.norm(G_rmv(G_mv(vvec)) + As.T @ (As @ vvec)) + 1e-12
+    )
+    scale = 1.0 + jnp.linalg.norm(cs) + jnp.linalg.norm(hs) + jnp.linalg.norm(bs)
+
+    x = x0 / jnp.maximum(d_c, 1e-12)
+    lam = jnp.maximum(lam0 / jnp.maximum(d_r[:m1], 1e-12), 0.0)
+    mu = mu0 / jnp.maximum(d_r[m1:], 1e-12)
+
+    # --- pad to lane-aligned kernel shapes ----------------------------------
+    nvp, m1p = _round_up(nv, _LANE), _round_up(m1, _LANE)
+    m2p = _round_up(m2, 8)
+    pn, pm1, pm2 = nvp - nv, m1p - m1, m2p - m2
+    idx_k = jnp.pad(idx, ((0, pm1), (0, 0)))
+    vs_k = jnp.pad(vals_s, ((0, pm1), (0, 0)))
+    As_k = jnp.pad(As, ((0, pm2), (0, pn)))
+    cs_k = jnp.pad(cs, (0, pn)).reshape(1, nvp)
+    hs_k = jnp.pad(hs, (0, pm1)).reshape(1, m1p)
+    bs_k = jnp.pad(bs, (0, pm2)).reshape(1, m2p)
+    x_k = jnp.pad(x, (0, pn)).reshape(1, nvp)
+    lam_k = jnp.pad(lam, (0, pm1)).reshape(1, m1p)
+    mu_k = jnp.pad(mu, (0, pm2)).reshape(1, m2p)
+
+    lane = jnp.arange(_LANE)
+    scal0 = jnp.zeros((_LANE,), jnp.float32)
+    for col, vcol in (
+        (_SL_IT, jnp.float32(0.0)),
+        (_SL_RES, jnp.float32(jnp.inf)),
+        (_SL_OMEGA, jnp.float32(1.0)),
+        (_SL_BEST, jnp.float32(jnp.inf)),
+        (_SL_NORM, norm), (_SL_SCALE, scale),
+        (_SL_TOL, tol.astype(jnp.float32)),
+    ):
+        scal0 = jnp.where(lane == col, vcol, scal0)
+    scal0 = scal0.reshape(1, _LANE)
+
+    state0 = (x_k, lam_k, mu_k, x_k, lam_k, mu_k, scal0)
+
+    def outer_cond(state):
+        sc = state[6]
+        return (
+            (sc[0, _SL_RES] > sc[0, _SL_TOL])
+            & (sc[0, _SL_IT] < float(max_iters))
+            & (sc[0, _SL_POIS] == 0.0)
+        )
+
+    def outer_body(state):
+        return _lp_block_call(
+            idx_k, vs_k, As_k, cs_k, hs_k, bs_k, state,
+            check_every=check_every, max_iters=max_iters,
+            sentinel=sentinel, interpret=interpret,
+        )
+
+    x_k, lam_k, mu_k, _, _, _, scal = jax.lax.while_loop(
+        outer_cond, outer_body, state0
+    )
+
+    it = scal[0, _SL_IT].astype(jnp.int32)
+    res = scal[0, _SL_RES]
+    x_out = x_k[0, :nv] * d_c
+    lam_out = lam_k[0, :m1] * d_r[:m1]
+    mu_out = mu_k[0, :m2] * d_r[m1:]
+    if sentinel:
+        flags = (
+            (scal[0, _SL_POIS] > 0.0).astype(jnp.int32)
+            + 2 * (scal[0, _SL_STALL] > 0.0).astype(jnp.int32)
+        )
+        return x_out, lam_out, mu_out, it, res, flags
+    return x_out, lam_out, mu_out, it, res
+
+
+lp_megakernel_core = partial(
+    jax.jit,
+    static_argnames=("max_iters", "check_every", "sentinel", "interpret"),
+    donate_argnums=(6, 7, 8),  # x0, lam0, mu0 — the chained-core contract
+)(_mk_lp_body)
+
+
+def dispatch_lp(
+    operands, *, cfg: Config, log=None, max_iters: int, check_every: int,
+    sentinel: bool, mode: str,
+):
+    """Span-wrapped launch of the fused generic-form solve. ``operands`` is
+    the device tuple ``(c, idx, val, h, A, b, x0, lam0, mu0, tol)``."""
+    nv = operands[0].shape[0]
+    m1, kp = operands[1].shape
+    m2 = operands[4].shape[0]
+    with dispatch_span(
+        "kernels.pdhg_megakernel_lp", cfg=cfg, log=log,
+        nv=int(nv), m1=int(m1), m2=int(m2), k_pad=int(kp), mode=mode,
+    ) as _ds:
+        with no_implicit_transfers(cfg):
+            out = lp_megakernel_core(
+                *operands,
+                max_iters=max_iters, check_every=check_every,
+                sentinel=sentinel, interpret=(mode == "interpret"),
+            )
+        _ds.out = out[:5]
+    if log is not None:
+        log.count("megakernel_dispatches")
+        log.count("megakernel_lanes")
+    return out
+
+
+# --- graftcheck-IR registrations (lint/ir.py) -------------------------------
+# Both fused cores register at the SAME shapes as their chained ELL twins
+# (dense_ref), so IR4's sparse_deltas table carries the fused-vs-chained
+# flops/bytes delta at a same-shape comparison. The kernel body is opaque to
+# the XLA cost model (the pallas_call reports no flops), so the fused budget
+# measures the prelude + launch structure; the L∞/parity contract is carried
+# by tests and the bench --kernels rows, not by the cost model. Interpret
+# mode keeps the trace CPU-portable, same as kernels.pallas_ell_matvec.
+
+
+@register_ir_core(
+    "kernels.pdhg_megakernel_two_sided",
+    dense_ref="batch_lp.polish_screen_ell",
+    span="kernels.pdhg_megakernel_two_sided",
+)
+def _ir_megakernel_two_sided() -> IRCase:
+    B, T, C, kp = 4, 128, 256, 16
+    r = np.random.default_rng(5)
+    idx = r.integers(0, T, size=(C, kp)).astype(np.int32)
+    val = (r.random((C, kp)) < 0.5).astype(np.float32)
+    S = lambda shape, dt=np.float32: jnp.asarray(  # noqa: E731
+        r.random(shape).astype(dt) if dt == np.float32
+        else np.zeros(shape, dt)
+    )
+    return IRCase(
+        fn=two_sided_megakernel_core,
+        args=(
+            jnp.asarray(idx),
+            jnp.asarray(val),
+            S((T,)),
+            jnp.ones((B, C), jnp.float32),
+            S((B, C + 1)),
+            S((B, 2 * T)),
+            S((B,)),
+            jnp.full((B,), 1e-6, jnp.float32),
+        ),
+        static=dict(
+            max_iters=1024, check_every=128, sentinel=False, interpret=True
+        ),
+        donate_expected=2,
+    )
+
+
+@register_ir_core(
+    "kernels.pdhg_megakernel_lp",
+    dense_ref="lp_pdhg.pdhg_core_ell",
+    span="kernels.pdhg_megakernel_lp",
+)
+def _ir_megakernel_lp() -> IRCase:
+    nv, m1, m2, kp = 65, 64, 1, 8
+    r = np.random.default_rng(6)
+    idx = r.integers(0, nv, size=(m1, kp)).astype(np.int32)
+    val = (r.random((m1, kp)) < 0.5).astype(np.float32)
+    return IRCase(
+        fn=lp_megakernel_core,
+        args=(
+            jnp.asarray(r.random(nv).astype(np.float32)),
+            jnp.asarray(idx),
+            jnp.asarray(val),
+            jnp.asarray(r.random(m1).astype(np.float32)),
+            jnp.ones((m2, nv), jnp.float32),
+            jnp.ones((m2,), jnp.float32),
+            jnp.zeros((nv,), jnp.float32),
+            jnp.zeros((m1,), jnp.float32),
+            jnp.zeros((m2,), jnp.float32),
+            jnp.asarray(1e-6, jnp.float32),
+        ),
+        static=dict(
+            max_iters=1024, check_every=128, sentinel=False, interpret=True
+        ),
+        donate_expected=3,
+    )

@@ -236,7 +236,7 @@ def verify_core(
                 _viol(
                     entry, "IR2", "f64-in-core",
                     f"core does not trace under enable_x64 ({exc!r}) — "
-                    "dtype-pin the offending literals (see kernels/sampler) "
+                    "dtype-pin the offending literals (see kernels/ell_matvec) "
                     "or tag the registration x64_trace=False with a reason",
                 )
             )

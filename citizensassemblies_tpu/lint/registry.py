@@ -87,7 +87,7 @@ _REGISTRY: Dict[str, CoreEntry] = {}
 #: these; keep the list sorted by package path so reports are deterministic.
 MANIFEST: Tuple[str, ...] = (
     "citizensassemblies_tpu.kernels.ell_matvec",
-    "citizensassemblies_tpu.kernels.sampler",
+    "citizensassemblies_tpu.kernels.pdhg_megakernel",
     "citizensassemblies_tpu.models.legacy",
     "citizensassemblies_tpu.parallel.mc",
     "citizensassemblies_tpu.parallel.solver",

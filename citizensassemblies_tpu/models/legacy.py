@@ -204,23 +204,22 @@ def sample_panels_batch(
 ):
     """Public batch draw; returns (panels[B,k], ok[B]) as device arrays.
 
-    ``sampler``: "scan" uses the lax.scan kernel; "pallas" the fused kernel
-    in ``kernels/sampler.py``; "auto" resolves to "scan". The Pallas kernel
-    is DEMOTED to opt-in (VERDICT r2 item #4): measured on a v5e across
-    B ∈ {1024, 4096, 16384} and n ∈ {200, 1727, 2000}, its throughput is
-    within ±6 % of the scan path — end-to-end sampler latency at these
-    shapes is dominated by dispatch/transfer, not the HBM mask traffic the
-    fusion removes, so VMEM residency has nothing to win. Both samplers draw
-    from the same greedy distribution (cross-checked statistically in
-    ``tests/test_kernels.py``); per-seed streams differ.
+    ``sampler``: "scan" uses the lax.scan kernel; "auto" resolves to "scan".
+    The former "pallas" opt-in (``kernels/sampler.py``) is REMOVED: measured
+    on a v5e across B ∈ {1024, 4096, 16384} and n ∈ {200, 1727, 2000}, its
+    throughput never decisively beat the scan path (11.9k vs 11.2k panels/s
+    at the reference shape, within the round-to-round variance band) —
+    end-to-end sampler latency at these shapes is dominated by
+    dispatch/transfer, not the HBM mask traffic the fusion removed, so VMEM
+    residency had nothing to win. The package's Pallas investment moved to
+    the PDHG megakernel (``kernels/pdhg_megakernel.py``), where the iterate
+    loop genuinely is HBM-bound.
 
     ``distribute``: shard the chains across the device mesh (the production
     multi-chip path for the reference's sequential 10k-draw estimator loop,
     ``analysis.py:180-187``). ``None`` auto-enables it when more than one
     device is visible; results are bit-identical to the single-device scan
-    kernel because chain randomness is keyed on global chain ids. The
-    distributed path always uses the scan kernel — device-count invariance
-    is part of its contract and the Pallas kernel draws a different stream.
+    kernel because chain randomness is keyed on global chain ids.
     """
     if distribute is None:
         distribute = len(jax.devices()) > 1 and batch >= len(jax.devices())
@@ -234,11 +233,12 @@ def sample_panels_batch(
     if sampler == "auto":
         sampler = "scan"
     if sampler == "pallas":
-        from citizensassemblies_tpu.kernels.sampler import sample_panels_pallas
-
-        return sample_panels_pallas(dense, key, batch, scores=scores, households=households)
+        raise ValueError(
+            "unknown sampler 'pallas': the fused sampler kernel was removed "
+            "(it never beat the scan path; see README 'Pallas verdicts')"
+        )
     if sampler != "scan":
-        raise ValueError(f"unknown sampler {sampler!r}: expected 'auto', 'pallas' or 'scan'")
+        raise ValueError(f"unknown sampler {sampler!r}: expected 'auto' or 'scan'")
     with dispatch_span("legacy.scan_sampler", chains=int(batch)) as _ds:
         out = _sample_panels_kernel(dense, key, batch, scores, households)
         _ds.out = out

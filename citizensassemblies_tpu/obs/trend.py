@@ -47,6 +47,7 @@ _ROW_RE = re.compile(r'"([A-Za-z0-9_]+)"\s*:\s*\{\s*"seconds"\s*:\s*([0-9.]+)')
 _OFFLINE_RE = re.compile(r"BENCH_r(\d+)\.json$")
 _DETAIL_RE = re.compile(r"BENCH_detail_r(\d+)\.json$")
 _SERVE_RE = re.compile(r"BENCH_serve_r(\d+)\.json$")
+_KERNELS_RE = re.compile(r"BENCH_kernels_r(\d+)\.json$")
 
 
 @dataclasses.dataclass
@@ -184,6 +185,14 @@ def collect_series(root) -> Tuple[Dict[str, List[Tuple[int, float]]], List[int]]
         m = _SERVE_RE.search(path.name)
         if m:
             rows = _load_serve(path)
+            if rows:
+                by_round.setdefault(int(m.group(1)), {}).update(rows)
+    for path in sorted(root.glob("BENCH_kernels_r*.json")):
+        # kernel microbench family (bench.py --kernels): same
+        # {"detail": {row: {"seconds": …}}} schema as the detail files
+        m = _KERNELS_RE.search(path.name)
+        if m:
+            rows = _load_offline(path)
             if rows:
                 by_round.setdefault(int(m.group(1)), {}).update(rows)
     series: Dict[str, List[Tuple[int, float]]] = {}

@@ -101,6 +101,7 @@ class RetryBudget:
 #: the certified fallback chain, in order: each rung is a Config gate whose
 #: off-position runs a pinned bit-identical (or certified-equivalent) path
 DEGRADATION_LADDER: Tuple[Tuple[str, Dict[str, Any]], ...] = (
+    ("megakernel_to_chained", {"pdhg_megakernel": False}),
     ("device_pricing_host_milp", {"decomp_device_pricing": False}),
     ("ell_to_dense", {"sparse_ops": False}),
     ("batched_to_serial", {"lp_batch": False}),

@@ -713,10 +713,18 @@ def solve_polish_screen_ell(
     )
 
     sent = sentinels_enabled(cfg)
-    core = _get_polish_screen_ell_core(
-        int(max_iters), int(cfg.pdhg_check_every), sentinel=sent
+    from citizensassemblies_tpu.kernels import pdhg_megakernel as _mk
+
+    mode = _mk.megakernel_mode(
+        cfg, _mk.two_sided_vmem_bytes(T, Cp, int(ell.k_pad))
     )
     bkey = f"ell_{T}x{Cp}x{ell.k_pad}x{B}"
+    if mode != "off":
+        bkey += "_mk"  # fused route compiles its own core: keep counters apart
+    else:
+        core = _get_polish_screen_ell_core(
+            int(max_iters), int(cfg.pdhg_check_every), sentinel=sent
+        )
     operands = (
         jnp.asarray(idx_p), jnp.asarray(val_p), jnp.asarray(v, jnp.float32),
         jnp.asarray(colmask), jnp.asarray(x0), jnp.asarray(lam0),
@@ -724,11 +732,18 @@ def solve_polish_screen_ell(
     )
     with dispatch_span(
         "batch_lp.polish_screen_ell", cfg=cfg, log=log, bucket=bkey,
-        lanes=int(B_real),
+        lanes=int(B_real), megakernel=mode,
     ) as _ds:
         with CompilationGuard(name=f"lp_batch_{bkey}") as guard:
-            with no_implicit_transfers(cfg):
-                core_out = core(*operands)
+            if mode != "off":
+                core_out = _mk.dispatch_two_sided(
+                    operands, cfg=cfg, log=log, max_iters=int(max_iters),
+                    check_every=int(cfg.pdhg_check_every), sentinel=sent,
+                    mode=mode, lanes=int(B_real),
+                )
+            else:
+                with no_implicit_transfers(cfg):
+                    core_out = core(*operands)
             x, lam, mu, it, res = core_out[:5]
             flags = (
                 np.asarray(core_out[5]) if sent else np.zeros(B, dtype=np.int32)

@@ -836,6 +836,25 @@ def solve_two_sided_master_async(
     sent = sentinels_enabled(cfg)
     T, C = MT.shape
     Cp = ((C + bucket - 1) // bucket) * bucket
+    if cfg.pdhg_megakernel is not False:
+        # fused route: the megakernel is ELL-native, so the dense master
+        # rides it through a column pack of MT (identical LP, identical
+        # warm/(x, lam, mu) contract). The VMEM fit check inside
+        # megakernel_mode keeps dense-fill packs off the kernel when the
+        # expansion would not fit; mode "off" falls through to the dense
+        # chained core untouched.
+        from citizensassemblies_tpu.kernels import pdhg_megakernel as _mk
+        from citizensassemblies_tpu.solvers.sparse_ops import EllPack
+
+        ell_mt = EllPack.from_rows(np.asarray(MT, np.float32).T, minor=T)
+        mode = _mk.megakernel_mode(
+            cfg, _mk.two_sided_vmem_bytes(int(T), int(Cp), int(ell_mt.k_pad))
+        )
+        if mode != "off":
+            return solve_two_sided_master_ell_async(
+                ell_mt, v, cfg=cfg, warm=warm, tol=tol, max_iters=max_iters,
+                bucket=bucket,
+            )
     MTp = np.zeros((T, Cp), dtype=np.float32)
     MTp[:, :C] = MT
     f32 = jnp.float32
@@ -971,6 +990,30 @@ def solve_two_sided_master_ell_async(
         jnp.asarray(mu0, f32),
         jnp.asarray(tol, jnp.float32),
     )
+    mi = int(max_iters if max_iters is not None else cfg.pdhg_max_iters)
+    ce = int(cfg.pdhg_check_every)
+    from citizensassemblies_tpu.kernels import pdhg_megakernel as _mk
+
+    mode = _mk.megakernel_mode(
+        cfg, _mk.two_sided_vmem_bytes(int(T), int(Cp), int(ell.k_pad))
+    )
+    if mode != "off":
+        # fused route: one kernel launch per PDHG block; the single solve
+        # rides the batched core as its lone lane
+        bops = (
+            operands[0], operands[1], operands[2], operands[3][None],
+            operands[4][None], operands[5][None], operands[6][None],
+            operands[7][None],
+        )
+        out = _mk.dispatch_two_sided(
+            bops, cfg=cfg, log=_ambient_log(), max_iters=mi, check_every=ce,
+            sentinel=sent, mode=mode, lanes=1,
+        )
+        return MasterHandle(
+            x=out[0][0], lam=out[1][0], mu=out[2][0:1].reshape(1),
+            it=out[3][0], res=out[4][0], Cp=Cp, tol=tol,
+            flags=out[5][0] if sent else None,
+        )
     with dispatch_span(
         "lp_pdhg.two_sided_core_ell", cfg=cfg, T=int(T), cols=int(Cp),
         k_pad=int(ell.k_pad),
@@ -978,8 +1021,8 @@ def solve_two_sided_master_ell_async(
         with no_implicit_transfers(cfg):
             out = _pdhg_two_sided_core_ell(
                 *operands,
-                max_iters=int(max_iters if max_iters is not None else cfg.pdhg_max_iters),
-                check_every=int(cfg.pdhg_check_every),
+                max_iters=mi,
+                check_every=ce,
                 sentinel=sent,
             )
         x, lam, mu, it, res = out[:5]
@@ -1205,18 +1248,31 @@ def solve_lp_ell(
     idx_d = jnp.asarray(ell.idx)
     val_d = jnp.asarray(ell.val)
     tol_ = jnp.asarray(tol, jnp.float32)
-    with dispatch_span(
-        "lp_pdhg.pdhg_core_ell", cfg=cfg, nv=int(nv), m1=int(m1), m2=int(m2)
-    ) as _ds:
-        with no_implicit_transfers(cfg):
-            out = _pdhg_core_ell(
-                c_, idx_d, val_d, h_, A_, b_, x0, lam0, mu0, tol_,
-                max_iters=int(cfg.pdhg_max_iters),
-                check_every=int(cfg.pdhg_check_every),
-                sentinel=sent,
-            )
+    from citizensassemblies_tpu.kernels import pdhg_megakernel as _mk
+
+    mode = _mk.megakernel_mode(
+        cfg, _mk.lp_vmem_bytes(int(m1), int(nv), int(ell.k_pad), int(m2))
+    )
+    if mode != "off":
+        out = _mk.dispatch_lp(
+            (c_, idx_d, val_d, h_, A_, b_, x0, lam0, mu0, tol_),
+            cfg=cfg, log=log, max_iters=int(cfg.pdhg_max_iters),
+            check_every=int(cfg.pdhg_check_every), sentinel=sent, mode=mode,
+        )
         x, lam, mu, it, res = out[:5]
-        _ds.out = (x, lam, mu, it, res)
+    else:
+        with dispatch_span(
+            "lp_pdhg.pdhg_core_ell", cfg=cfg, nv=int(nv), m1=int(m1), m2=int(m2)
+        ) as _ds:
+            with no_implicit_transfers(cfg):
+                out = _pdhg_core_ell(
+                    c_, idx_d, val_d, h_, A_, b_, x0, lam0, mu0, tol_,
+                    max_iters=int(cfg.pdhg_max_iters),
+                    check_every=int(cfg.pdhg_check_every),
+                    sentinel=sent,
+                )
+            x, lam, mu, it, res = out[:5]
+            _ds.out = (x, lam, mu, it, res)
     flags = int(np.asarray(out[5])) if sent else 0
     if flags & FLAG_POISONED:
         if log is not None:

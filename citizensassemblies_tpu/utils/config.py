@@ -203,6 +203,23 @@ class Config:
     #: both the current and the averaged iterate), so checking every 64 was
     #: ~20 % of the whole solve
     pdhg_check_every: int = 128
+    #: route the PDHG hot cores through the fused Pallas megakernel
+    #: (``kernels/pdhg_megakernel.py``): one ``pallas_call`` per PDHG block
+    #: keeps x/y and the packed ELL values VMEM-resident across
+    #: ``pdhg_check_every`` iterations instead of shuttling them through HBM
+    #: between every XLA op. ``None`` = auto (real accelerator backends
+    #: only, and only when the kernel's estimated VMEM working set fits the
+    #: budget below); ``True`` forces the fused path (interpret mode on
+    #: non-TPU backends — the CPU test route); ``False`` ⇒ every consumer
+    #: runs the chained ``_two_sided_iterate``/``_pdhg_body_ell`` cores
+    #: bit-identically.
+    pdhg_megakernel: Optional[bool] = None
+    #: per-core VMEM budget (MiB) for the megakernel fit check: instances
+    #: whose transposed-pack expansion + operands exceed this fall back to
+    #: the chained cores instead of compiling a spilling kernel (~16 MiB
+    #: physical per TPU core; the default leaves headroom for Mosaic's own
+    #: scratch).
+    pdhg_megakernel_vmem_mb: int = 12
 
     # --- batched LP/QP engine (solvers/batch_lp.py) ---------------------------
     #: fuse fleets of small independent LP/QP solves into padded, vmapped

@@ -226,7 +226,8 @@ def test_registry_enumerates_the_hot_cores():
     for expected in (
         "lp_pdhg.pdhg_core", "lp_pdhg.two_sided_core", "batch_lp.vmapped_core",
         "qp.l2_fused_core", "face_decompose.move_screen",
-        "kernels.pallas_sampler", "legacy.scan_sampler",
+        "kernels.pdhg_megakernel_two_sided", "kernels.pdhg_megakernel_lp",
+        "legacy.scan_sampler",
         "parallel.sharded_dual_lp", "sweep.alloc_core",
     ):
         assert expected in names

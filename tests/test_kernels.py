@@ -1,7 +1,7 @@
-"""Fused Pallas sampler kernel (``kernels/sampler.py``) — semantics vs the
-lax.scan path: feasibility of accepted panels, household eviction, score bias,
-and distribution-level agreement (both are rejection samplers of the same
-greedy process; per-seed streams differ). Runs in interpret mode on CPU."""
+"""Sampler dispatch contract after the Pallas sampler removal: "auto" is the
+scan path, the removed "pallas" opt-in raises with a pointer to the verdict,
+and unknown names still raise. (The Pallas investment moved to the PDHG
+megakernel — ``tests/test_megakernel.py``.)"""
 
 import jax
 import numpy as np
@@ -9,7 +9,6 @@ import pytest
 
 from citizensassemblies_tpu.core.generator import random_instance
 from citizensassemblies_tpu.core.instance import featurize
-from citizensassemblies_tpu.kernels.sampler import sample_panels_pallas
 from citizensassemblies_tpu.models.legacy import sample_panels_batch
 
 
@@ -19,106 +18,32 @@ def dense():
     return featurize(inst)[0]
 
 
-def _feasible(dense, panel):
-    counts = np.asarray(dense.A)[panel].sum(axis=0)
-    return (
-        len(set(panel.tolist())) == dense.k
-        and (counts >= np.asarray(dense.qmin)).all()
-        and (counts <= np.asarray(dense.qmax)).all()
-    )
-
-
-def test_pallas_accepted_panels_feasible(dense):
-    panels, ok = map(np.asarray, sample_panels_pallas(dense, jax.random.PRNGKey(0), 256))
-    assert ok.any()
-    for p in panels[ok]:
-        assert _feasible(dense, p)
-
-
-def test_pallas_matches_scan_distribution(dense):
-    """Allocation frequencies agree within two-sample MC noise."""
-    B = 4096
-    p1, ok1 = map(np.asarray, sample_panels_pallas(dense, jax.random.PRNGKey(1), B))
-    p2, ok2 = map(np.asarray, sample_panels_batch(dense, jax.random.PRNGKey(1), B, sampler="scan"))
-    a1 = np.bincount(p1[ok1].ravel(), minlength=dense.n) / max(ok1.sum(), 1)
-    a2 = np.bincount(p2[ok2].ravel(), minlength=dense.n) / max(ok2.sum(), 1)
-    # 4σ two-sample bound at the worst-case observed frequency, with the
-    # effective sample size = accepted draws (not the attempted batch)
-    n_eff = int(min(ok1.sum(), ok2.sum()))
-    pmax = max(a1.max(), a2.max())
-    bound = 4.0 * np.sqrt(2.0 * pmax * (1 - pmax) / max(n_eff, 1))
-    assert np.abs(a1 - a2).max() < bound
-
-
-def test_pallas_household_eviction(dense):
-    hh = np.arange(dense.n)
-    hh[:3] = 0
-    hh[3:6] = 1
-    panels, ok = map(
-        np.asarray, sample_panels_pallas(dense, jax.random.PRNGKey(2), 512, households=hh)
-    )
-    for p in panels[ok]:
-        _, counts = np.unique(hh[p], return_counts=True)
-        assert (counts <= 1).all()
-
-
-def test_pallas_score_bias(dense):
-    sc = np.zeros(dense.n, dtype=np.float32)
-    sc[0] = 5.0
-    pb, okb = map(np.asarray, sample_panels_pallas(dense, jax.random.PRNGKey(3), 512, scores=sc))
-    pu, oku = map(np.asarray, sample_panels_pallas(dense, jax.random.PRNGKey(3), 512))
-    f_biased = (pb[okb] == 0).any(axis=1).mean()
-    f_plain = (pu[oku] == 0).any(axis=1).mean()
-    assert f_biased > f_plain + 0.3
-
-
-def test_pallas_tight_quotas_honest_ok_flags():
-    inst = random_instance(n=40, k=10, n_categories=2, features_per_category=2, seed=9)
-    for cat in inst.categories.values():
-        for f in list(cat):
-            cat[f] = (5, 5)  # exact cell counts — every accepted panel must hit them
-    dense, _ = featurize(inst)
-    panels, ok = map(np.asarray, sample_panels_pallas(dense, jax.random.PRNGKey(4), 512))
-    assert ok.any()
-    for p in panels[ok]:
-        counts = np.asarray(dense.A)[p].sum(axis=0)
-        assert (counts == 5).all()
-
-
-def test_dispatch_auto_prefers_scan_off_tpu(dense):
-    # on CPU the auto sampler must be the scan path: same key ⇒ identical
-    # draws (the pallas path uses a different RNG stream, so this would fail
-    # if auto dispatched to it)
+def test_dispatch_auto_is_scan(dense):
+    # "auto" must be the scan path: same key ⇒ identical draws
     key = jax.random.PRNGKey(5)
     pa, oka = map(np.asarray, sample_panels_batch(dense, key, 64, sampler="auto"))
     ps, oks = map(np.asarray, sample_panels_batch(dense, key, 64, sampler="scan"))
     assert (pa == ps).all() and (oka == oks).all()
 
 
+def test_dispatch_scan_panels_feasible(dense):
+    panels, ok = map(
+        np.asarray, sample_panels_batch(dense, jax.random.PRNGKey(0), 256, sampler="scan")
+    )
+    assert ok.any()
+    A = np.asarray(dense.A)
+    for p in panels[ok]:
+        counts = A[p].sum(axis=0)
+        assert len(set(p.tolist())) == dense.k
+        assert (counts >= np.asarray(dense.qmin)).all()
+        assert (counts <= np.asarray(dense.qmax)).all()
+
+
+def test_dispatch_pallas_sampler_removed(dense):
+    with pytest.raises(ValueError, match="unknown sampler 'pallas'"):
+        sample_panels_batch(dense, jax.random.PRNGKey(0), 8, sampler="pallas")
+
+
 def test_dispatch_unknown_sampler_raises(dense):
     with pytest.raises(ValueError, match="unknown sampler"):
         sample_panels_batch(dense, jax.random.PRNGKey(0), 8, sampler="pallass")
-
-
-def test_scores_shape_validation(dense):
-    with pytest.raises(ValueError, match="scores must have shape"):
-        sample_panels_pallas(
-            dense, jax.random.PRNGKey(0), 64,
-            scores=np.zeros((32, dense.n), dtype=np.float32),  # 1 < rows < B
-        )
-
-
-def test_vmem_block_sizing():
-    from citizensassemblies_tpu.kernels.sampler import pick_block_b
-
-    assert pick_block_b(128, 128) == 256  # tiny instance: full block
-    assert pick_block_b(2048, 128) > 0  # sf_e-like still fits
-    assert pick_block_b(1 << 20, 128) == 0  # absurd n: must fall back to scan
-    # feature-heavy instances are bounded by the [block_b, F_pad] buffers
-    assert pick_block_b(128, 8192) < 256
-
-
-def test_block_for_dense_matches_wrapper(dense):
-    from citizensassemblies_tpu.kernels.sampler import block_for_dense
-
-    assert block_for_dense(dense) == 256  # n=60, F≈6: comfortably fits

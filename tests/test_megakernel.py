@@ -1,0 +1,261 @@
+"""Fused Pallas PDHG megakernel (``kernels/pdhg_megakernel.py``) — the
+contract of ISSUE 14: interpret-mode parity vs the chained ELL iterate
+(flagship- and household-quotient-shaped fixtures), tri-state gate semantics
+with gate-off bitwise identity, warm-start slot survival across bucket
+re-pads, realized donation (IR3), and ``pdhg_nan`` quarantine + host
+re-solve through the fused path. All fused runs here use interpret mode
+(``pdhg_megakernel=True`` off-TPU); the chained baselines are the default
+CPU path (``pdhg_megakernel=False`` or the ``None`` auto-gate, which
+resolves to "off" without a real accelerator)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.kernels import pdhg_megakernel as mk
+from citizensassemblies_tpu.robust.inject import FaultInjector, use_injector
+from citizensassemblies_tpu.solvers.lp_pdhg import (
+    solve_lp_ell,
+    solve_two_sided_master_ell,
+)
+from citizensassemblies_tpu.solvers.sparse_ops import EllPack
+from citizensassemblies_tpu.utils.config import default_config
+
+
+def _cfg(**kw):
+    return default_config().replace(**kw)
+
+
+CFG_FUSED = _cfg(pdhg_megakernel=True)
+CFG_CHAINED = _cfg(pdhg_megakernel=False)
+
+
+def _flagship_master(seed=7, T=24, C=96):
+    """The bench smoke fixture shape: the sf_e-style composition matrix of
+    the decomposition master (counts over T types, scaled by 1/k)."""
+    r = np.random.default_rng(seed)
+    comps = (r.random((C, T)) < 0.2) * r.integers(1, 4, (C, T))
+    MT = (comps / 8.0).T.astype(np.float64)
+    v = MT @ np.full(C, 1.0 / C)
+    ell = EllPack.from_rows(np.asarray(MT, np.float32).T, minor=T)
+    return ell, v
+
+
+def _household_master(seed=11, T=40, C=64):
+    """Household-quotient shape: more types than the flagship fixture
+    relative to the column count, sparser integer cells (the product
+    type-space of the household quotient, PR 10)."""
+    r = np.random.default_rng(seed)
+    comps = (r.random((C, T)) < 0.12) * r.integers(1, 3, (C, T))
+    comps[:, 0] = 1  # every composition hits the root cell
+    MT = (comps / 4.0).T.astype(np.float64)
+    v = MT @ np.full(C, 1.0 / C)
+    ell = EllPack.from_rows(np.asarray(MT, np.float32).T, minor=T)
+    return ell, v
+
+
+def _master_pair(fixture, **kw):
+    ell, v = fixture
+    a = solve_two_sided_master_ell(ell, v, cfg=CFG_CHAINED, **kw)
+    b = solve_two_sided_master_ell(ell, v, cfg=CFG_FUSED, **kw)
+    return a, b
+
+
+# --- tri-state gate ----------------------------------------------------------
+
+
+def test_megakernel_mode_tri_state():
+    small = mk.two_sided_vmem_bytes(128, 256, 16)
+    assert mk.megakernel_mode(_cfg(pdhg_megakernel=False), small) == "off"
+    # auto engages only on a real accelerator; this suite runs on CPU
+    assert jax.default_backend() != "tpu"
+    assert mk.megakernel_mode(_cfg(pdhg_megakernel=None), small) == "off"
+    assert mk.megakernel_mode(CFG_FUSED, small) == "interpret"
+    # the VMEM fit check applies in EVERY mode: an expansion that cannot
+    # stay on-chip falls back to the chained cores rather than spilling
+    huge = mk.two_sided_vmem_bytes(4096, 65536, 128)
+    assert mk.megakernel_mode(CFG_FUSED, huge) == "off"
+    assert mk.megakernel_mode(_cfg(pdhg_megakernel=None), huge) == "off"
+
+
+def test_gate_off_bitwise_identity():
+    """cfg(None) on CPU and cfg(False) are the SAME chained path — gate-off
+    must be bit-identical, not merely close."""
+    ell, v = _flagship_master()
+    auto = solve_two_sided_master_ell(ell, v, cfg=_cfg(pdhg_megakernel=None))
+    off = solve_two_sided_master_ell(ell, v, cfg=CFG_CHAINED)
+    np.testing.assert_array_equal(auto.x, off.x)
+    np.testing.assert_array_equal(auto.lam, off.lam)
+    np.testing.assert_array_equal(auto.mu, off.mu)
+    assert auto.iters == off.iters and auto.kkt == off.kkt
+
+
+# --- interpret-mode parity vs the chained ELL iterate ------------------------
+
+
+def test_parity_flagship_shape():
+    a, b = _master_pair(_flagship_master())
+    assert a.ok and b.ok
+    assert np.max(np.abs(a.x - b.x)) < 5e-4
+    assert np.max(np.abs(a.lam - b.lam)) < 5e-4
+    assert abs(a.objective - b.objective) < 5e-5
+
+
+def test_parity_household_quotient_shape():
+    a, b = _master_pair(_household_master())
+    assert a.ok and b.ok
+    assert np.max(np.abs(a.x - b.x)) < 5e-4
+    assert np.max(np.abs(a.lam - b.lam)) < 5e-4
+    assert abs(a.objective - b.objective) < 5e-5
+
+
+def test_parity_generic_lp_route():
+    """solve_lp_ell (the generic-form consumer) through the fused kernel."""
+    r = np.random.default_rng(3)
+    nv, m1, m2 = 40, 32, 1
+    G = (r.random((m1, nv)) < 0.25) * r.random((m1, nv))
+    h = G @ np.full(nv, 1.0 / nv) + 0.01
+    A = np.ones((1, nv))
+    b = np.ones(1)
+    c = r.random(nv)
+    ell = EllPack.from_rows(np.asarray(G, np.float32), minor=nv)
+    a = solve_lp_ell(c, ell, h, A, b, cfg=CFG_CHAINED)
+    bsol = solve_lp_ell(c, ell, h, A, b, cfg=CFG_FUSED)
+    assert a.ok and bsol.ok
+    assert np.max(np.abs(a.x - bsol.x)) < 5e-4
+    assert abs(a.objective - bsol.objective) < 5e-5
+
+
+def test_parity_batched_polish_screen():
+    """solve_polish_screen_ell: per-lane iteration counts match the chained
+    vmapped core exactly and iterates agree to float32 op-order noise."""
+    from citizensassemblies_tpu.solvers.batch_lp import solve_polish_screen_ell
+
+    ell, v = _flagship_master()
+    caps = [96, 48, 24]
+    warms = [None] * len(caps)
+    off = solve_polish_screen_ell(ell, v, caps, warms, 1e-5, 4096, cfg=CFG_CHAINED)
+    on = solve_polish_screen_ell(ell, v, caps, warms, 1e-5, 4096, cfg=CFG_FUSED)
+    for a, b in zip(off, on):
+        assert a.ok == b.ok
+        assert a.iters == b.iters  # per-lane convergence masks agree
+        assert np.max(np.abs(a.x - b.x)) < 5e-4
+
+
+# --- warm-start slot survival across bucket re-pads --------------------------
+
+
+def test_warm_slot_survives_bucket_repad():
+    """A warm triple from a Cp=128 solve is re-sliced into the Cp=256
+    bucket when the column count grows past the pad (the CG append path);
+    the fused route must consume it exactly like the chained route — warm
+    restarts converge in strictly fewer blocks than cold on both paths."""
+    ell_small, v = _flagship_master(C=96)  # Cp=128 at bucket=128
+    # grow the same master by 64 fresh columns: Cp re-pads 128 → 256
+    r7 = np.random.default_rng(7)
+    comps = (r7.random((96, 24)) < 0.2) * r7.integers(1, 4, (96, 24))
+    r19 = np.random.default_rng(19)
+    extra = (r19.random((64, 24)) < 0.2) * r19.integers(1, 4, (64, 24))
+    rows = np.concatenate([comps / 8.0, extra / 8.0], axis=0).astype(np.float32)
+    ell_big = EllPack.from_rows(rows, minor=24)
+    sol_small = solve_two_sided_master_ell(
+        ell_small, v, cfg=CFG_FUSED, bucket=128
+    )
+    warm = (sol_small.x, sol_small.lam, sol_small.mu)
+    kw = dict(v=v, warm=warm, bucket=128)
+    cold_f = solve_two_sided_master_ell(ell_big, v, cfg=CFG_FUSED, bucket=128)
+    warm_f = solve_two_sided_master_ell(ell_big, cfg=CFG_FUSED, **kw)
+    cold_c = solve_two_sided_master_ell(ell_big, v, cfg=CFG_CHAINED, bucket=128)
+    warm_c = solve_two_sided_master_ell(ell_big, cfg=CFG_CHAINED, **kw)
+    assert warm_f.ok and warm_c.ok
+    # the slot survived the 128→256 re-pad: warm beats cold on BOTH paths,
+    # and fused/chained agree on the warm-started optimum
+    assert warm_f.iters < cold_f.iters
+    assert warm_c.iters < cold_c.iters
+    assert abs(warm_f.objective - warm_c.objective) < 5e-5
+
+
+# --- realized donation (IR3) -------------------------------------------------
+
+
+def _alias_count(lowered) -> int:
+    return lowered.as_text().count("tf.aliasing_output")
+
+
+def test_two_sided_core_realizes_donation():
+    B, T, C, kp = 2, 24, 96, 8
+    r = np.random.default_rng(0)
+    idx = jnp.asarray(r.integers(0, T, (C, kp)).astype(np.int32))
+    val = jnp.asarray(r.random((C, kp)).astype(np.float32))
+    low = mk.two_sided_megakernel_core.lower(
+        idx, val, jnp.zeros(T, jnp.float32), jnp.ones((B, C), jnp.float32),
+        jnp.zeros((B, C + 1), jnp.float32), jnp.zeros((B, 2 * T), jnp.float32),
+        jnp.zeros(B, jnp.float32), jnp.full(B, 1e-6, jnp.float32),
+        max_iters=256, check_every=64, sentinel=False, interpret=True,
+    )
+    assert _alias_count(low) == 2  # x0 and lam0 donate through the pad
+
+
+def test_lp_core_realizes_donation():
+    nv, m1, m2, kp = 40, 32, 1, 8
+    r = np.random.default_rng(1)
+    idx = jnp.asarray(r.integers(0, nv, (m1, kp)).astype(np.int32))
+    val = jnp.asarray(r.random((m1, kp)).astype(np.float32))
+    low = mk.lp_megakernel_core.lower(
+        jnp.zeros(nv, jnp.float32), idx, val, jnp.ones(m1, jnp.float32),
+        jnp.ones((m2, nv), jnp.float32), jnp.ones(m2, jnp.float32),
+        jnp.zeros(nv, jnp.float32), jnp.zeros(m1, jnp.float32),
+        jnp.zeros(m2, jnp.float32), jnp.asarray(1e-6, jnp.float32),
+        max_iters=256, check_every=64, sentinel=False, interpret=True,
+    )
+    assert _alias_count(low) == 3  # x0, lam0 and mu0
+
+
+# --- sentinels: quarantine + host re-solve through the fused path ------------
+
+
+def test_pdhg_nan_quarantine_host_resolve_fused():
+    """pdhg_nan poisons the warm start; the in-kernel sentinel must freeze
+    the lane (FLAG_POISONED) and solve_lp_ell's float64 host re-solve must
+    recover — same ladder as the chained path, now through the kernel."""
+    r = np.random.default_rng(3)
+    nv, m1 = 40, 32
+    G = (r.random((m1, nv)) < 0.25) * r.random((m1, nv))
+    h = G @ np.full(nv, 1.0 / nv) + 0.01
+    A, b = np.ones((1, nv)), np.ones(1)
+    c = r.random(nv)
+    ell = EllPack.from_rows(np.asarray(G, np.float32), minor=nv)
+    with use_injector(FaultInjector("pdhg_nan:1.0", seed=5)):
+        out = solve_lp_ell(c, ell, h, A, b, cfg=CFG_FUSED)
+    assert np.all(np.isfinite(out.x))
+    assert out.iters == -1  # the certified host optimum, not the frozen lane
+    assert out.ok
+
+
+def test_poisoned_lane_isolated_in_fused_batch():
+    """One NaN warm lane through the batched fused screen: that lane is
+    quarantined (ok=False, frozen-finite iterate) while its fleet mates are
+    BIT-identical to the clean fused dispatch."""
+    from citizensassemblies_tpu.solvers.batch_lp import solve_polish_screen_ell
+
+    ell, v = _flagship_master()
+    caps = [96, 48, 24]
+    clean = solve_polish_screen_ell(
+        ell, v, caps, [None] * 3, 1e-5, 4096, cfg=CFG_FUSED
+    )
+    bad = np.zeros(97, np.float64)
+    bad[0] = np.nan
+    poisoned_warms = [None, (bad, np.zeros(48), np.zeros(1)), None]
+    mixed = solve_polish_screen_ell(
+        ell, v, caps, poisoned_warms, 1e-5, 4096, cfg=CFG_FUSED
+    )
+    # the poisoned lane is quarantined exactly like the chained vmapped
+    # core: frozen at iterate 0 (the poisoned input IS the last "iterate",
+    # so there is no finite state to freeze at), kkt=inf, ok=False — the
+    # screen's caller-side float64 accept check rejects it
+    assert not mixed[1].ok
+    assert mixed[1].iters == 0 and not np.isfinite(mixed[1].kkt)
+    for lane in (0, 2):  # …and its fleet mates never see the NaN
+        np.testing.assert_array_equal(mixed[lane].x, clean[lane].x)
+        assert mixed[lane].iters == clean[lane].iters

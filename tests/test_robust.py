@@ -241,9 +241,9 @@ def test_service_retry_walks_ladder_and_still_certifies():
     assert res.audit["counters"].get("fault_worker_crash", 0) == 1
     assert res.audit["counters"].get("robust_retry", 0) == 1
     assert res.audit["retries_used"] == 1
-    # the retry walked the first ladder rung
+    # the retry walked the first ladder rung (megakernel → chained cores)
     assert res.audit["counters"].get(
-        "robust_degrade_device_pricing_host_milp", 0
+        "robust_degrade_megakernel_to_chained", 0
     ) == 1
     assert res.audit["contract_ok"] is True
     assert res.audit["realization_dev"] <= 1e-3
