@@ -1127,6 +1127,22 @@ def smoke() -> int:
         "metrics_file": os.path.basename(str(metrics_path)),
     }
 
+    # --- graftscope roofline join: static↔runtime totality ------------------
+    # every dispatch span the traced decomposition fired must join a row of
+    # the committed ANALYSIS_BUDGET.json — a miss means a core executed that
+    # the static layer cannot see, exactly the drift R10/check-ir guard
+    # against, now cross-checked at runtime on every CI run. (Achieved
+    # rates are NOT asserted here: the decomposition runs at its own
+    # shapes; the honest-rate rows come from ``--roofline``.)
+    from citizensassemblies_tpu.obs import roofline_join
+
+    roof = roofline_join([obs_tracer])
+    if roof.misses:
+        failures.append(
+            f"roofline join misses (span with no budget row): {roof.misses}"
+        )
+    obs_stamp["roofline_cores_joined"] = len(roof.rows)
+
     # --- tiny end-to-end parity (engine on vs off) + warm compile bound ----
     dense, space = featurize(random_instance(n=64, k=8, n_categories=2, seed=0))
     d_off = find_distribution_leximin(dense, space, cfg=cfg.replace(lp_batch=False))
@@ -1136,12 +1152,42 @@ def smoke() -> int:
     )
     if e2e > 1e-6:
         failures.append(f"engine on/off certified-value drift {e2e:.2e} > 1e-6")
-    with CompilationGuard(name="smoke_leximin", max_compiles=None) as lex_guard:
-        find_distribution_leximin(dense, space, cfg=cfg)
+    # graftscope leak sentinel: ≥ 3 warm flagship reps under an ambient
+    # memory ledger — STRICTLY monotone live-byte growth across warm reps
+    # is a leak verdict and fails the smoke (warm reps re-entering compiled
+    # code must reach a steady state, not accrete device buffers per call)
+    from citizensassemblies_tpu.obs import MemoryLedger, leak_verdict, use_ledger
+
+    mem_ledger = MemoryLedger(name="smoke_warm_leximin")
+    mem_ledger.snapshot("baseline")
+    with use_ledger(mem_ledger):
+        with CompilationGuard(name="smoke_leximin", max_compiles=None) as lex_guard:
+            find_distribution_leximin(dense, space, cfg=cfg)
+        mem_ledger.snapshot("warm_rep")
+        for _rep in range(2):
+            find_distribution_leximin(dense, space, cfg=cfg)
+            mem_ledger.snapshot("warm_rep")
     if lex_guard.count > bound:
         failures.append(
             f"warm leximin rep compiled {lex_guard.count}x > bound {bound}"
         )
+    live_series = mem_ledger.series("warm_rep")
+    if leak_verdict(live_series):
+        failures.append(
+            f"leak sentinel: live bytes grew monotonically across "
+            f"{len(live_series)} warm leximin reps: {live_series}"
+        )
+    mem_full = mem_ledger.stamp()
+    mem_stamp = {
+        "schema_version": mem_full["schema_version"],
+        "snapshots": mem_full["snapshots"],
+        "high_watermark_bytes": mem_full["high_watermark_bytes"],
+        "live_bytes_warm_reps": live_series,
+        "live_arrays_last": mem_full.get("live_arrays_last"),
+        "leak": leak_verdict(live_series),
+        # top-5 owners by resident cached bytes (full map in the ledger)
+        "owners_top": dict(list(mem_full.get("owners", {}).items())[:5]),
+    }
 
     print(
         json.dumps(
@@ -1170,6 +1216,7 @@ def smoke() -> int:
                 "warm_fleet_compiles": warm_guard.count,
                 "warm_leximin_compiles": lex_guard.count,
                 "obs": obs_stamp,
+                "memory": mem_stamp,
                 "failures": failures,
             }
         )
@@ -1313,6 +1360,153 @@ def kernels_bench(smoke_mode: bool = False) -> int:
     return 1 if failures else 0
 
 
+def roofline_bench(smoke_mode: bool = False) -> int:
+    """``--roofline``: graftscope runtime roofline attribution over the
+    full IR-core registry.
+
+    Drives every registered core through its OWN :class:`IRCase` — the
+    jitted callable at the exact representative shapes the committed
+    ``ANALYSIS_BUDGET.json`` flops/bytes were measured at — under a
+    device-sampling tracer, then joins measured dispatch seconds against
+    the static budget (``obs/roofline.py``): achieved GFLOP/s and GB/s,
+    arithmetic intensity, and a bytes-/compute-bound verdict per core
+    against the ``Config.obs_roofline_ridge`` machine balance. Budget
+    shapes == executed shapes by construction, so the rates are honest;
+    the ``backend`` field records the regime (CPU CI wall times are CPU
+    numbers, same posture as the kernel rows).
+
+    ``--roofline --smoke`` asserts the static↔runtime join is TOTAL:
+    every fired span joined a budget row (no misses), every budgeted core
+    executed, every call was device-sampled, and every row's achieved
+    rate is finite. Writes ``ROOFLINE_rNN.json`` (round = 1 past the
+    newest committed round; env ``BENCH_ROOFLINE_PATH`` overrides) with a
+    ``detail`` block in the BENCH row schema, so ``obs/trend.py`` folds
+    the per-core seconds into the regression gate as a new row family.
+    """
+    import re
+
+    import jax
+    import numpy as np
+
+    from citizensassemblies_tpu.lint.registry import collect
+    from citizensassemblies_tpu.obs import (
+        Tracer,
+        dispatch_span,
+        roofline_join,
+        use_tracer,
+    )
+    from citizensassemblies_tpu.utils.config import default_config
+
+    t_start = time.time()
+    failures = []
+    reps = 1 if smoke_mode else 3
+    cfg = default_config().replace(obs_trace=True)
+    tracer = Tracer(name="roofline", sample_device=True)
+
+    def _concrete(leaf):
+        # materialize an IRCase example operand: zeros for integer/bool
+        # dtypes (gather/scatter indices stay in range), a deterministic
+        # non-constant fill for floats — reruns are bit-stable
+        if not isinstance(leaf, jax.ShapeDtypeStruct):
+            # some cases register CONCRETE operands (pallas cores whose
+            # index structure must be real, not zeros); copy them so a
+            # donating call never sees a buffer a previous rep consumed
+            if isinstance(leaf, jax.Array):
+                return np.array(leaf)
+            return leaf
+        dt = np.dtype(leaf.dtype)
+        if dt.kind in "iub":
+            return np.zeros(leaf.shape, dtype=dt)
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        vals = 0.1 + 0.8 * ((np.arange(size) % 97) / 96.0)
+        return np.asarray(vals, dtype=dt).reshape(leaf.shape)
+
+    def _materialize(args):
+        return jax.tree_util.tree_map(
+            _concrete, args,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        )
+
+    core_errors = []
+    with use_tracer(tracer):
+        for entry in collect():
+            try:
+                case = entry.build()
+                # warm the executable OUTSIDE any span — compile time must
+                # not pollute the measured dispatch seconds
+                out = case.fn(*_materialize(case.args), **case.static)
+                jax.block_until_ready(out)
+                for _ in range(reps):
+                    # fresh operands every call: donating cores consumed
+                    # the previous buffers
+                    operands = _materialize(case.args)
+                    with dispatch_span(entry.name, cfg=cfg) as ds:
+                        ds.out = case.fn(*operands, **case.static)
+            except Exception as exc:  # noqa: BLE001 - sweep-survivable
+                core_errors.append(f"{entry.name}: {exc!r}")
+    if core_errors:
+        failures.append(f"cores failed to execute: {core_errors[:3]}")
+
+    report = roofline_join([tracer])
+    if report.misses:
+        failures.append(
+            f"roofline join misses (span with no budget row): {report.misses}"
+        )
+    if report.unexecuted:
+        failures.append(f"budgeted cores never fired: {report.unexecuted}")
+    bad_rows = [r.core for r in report.rows if not r.finite]
+    if bad_rows:
+        failures.append(f"non-finite achieved rates: {bad_rows}")
+    unsampled = [r.core for r in report.rows if not r.sampled]
+    if unsampled:
+        failures.append(f"rows timed host enqueue, not execution: {unsampled}")
+
+    # round number: 1 past the newest committed ROOFLINE_r*.json (15 seeds
+    # the family), so re-running the bench next PR auto-advances the series
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(m.group(1))
+        for f in os.listdir(repo_root)
+        if (m := re.match(r"ROOFLINE_r(\d+)\.json$", f))
+    ]
+    rnd = (max(rounds) + 1) if rounds else 15
+
+    doc = {
+        "schema_version": 1,
+        "roofline_ok": not failures,
+        "round": rnd,
+        "seconds": round(time.time() - t_start, 1),
+        "backend": jax.default_backend(),
+        "smoke": bool(smoke_mode),
+        "reps_per_core": reps,
+        "cores": len(report.rows),
+        "bytes_bound": sum(1 for r in report.rows if r.bound == "bytes-bound"),
+        "compute_bound": sum(
+            1 for r in report.rows if r.bound == "compute-bound"
+        ),
+        "detail": report.trend_detail(),
+        "report": report.as_json(),
+        "failures": failures,
+    }
+    print(json.dumps(doc))
+    out_path = os.environ.get("BENCH_ROOFLINE_PATH") or os.path.join(
+        _artifacts_dir(), f"ROOFLINE_r{rnd:02d}.json"
+    )
+    try:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+    except OSError:
+        pass
+    return 1 if failures else 0
+
+
+#: the committed serving SLO spec the serve bench gates on — p99 under the
+#: smoke fleet's worst honest latency with CI headroom, error budget 1 %.
+#: README "Memory, roofline & SLOs (graftscope)" documents the grammar.
+_SERVE_SLO_SPEC = "latency_p99:30s,error_rate:0.01"
+
+
 def serve_bench(smoke_mode: bool = False) -> int:
     """graftserve bench: drive a mixed fleet of whole selection instances
     through the async service and measure the SERVING metrics — p50/p99
@@ -1346,10 +1540,15 @@ def serve_bench(smoke_mode: bool = False) -> int:
     # trace artifact merges them, one process lane per request), and the
     # smoke's short metrics interval exercises the periodic ("metrics", …)
     # channel snapshots the streaming satellite added.
+    # graftscope: obs_memory=True stamps every request audit with its
+    # memory-ledger block; obs_slo_spec arms the service SLO engine on the
+    # committed spec the smoke gates on below
     cfg = default_config().replace(
         lp_batch=True, serve_batch_window_ms=8.0, serve_admission_cap=8,
         obs_trace=True,
         obs_metrics_interval_s=(0.2 if smoke_mode else 0.0),
+        obs_memory=True,
+        obs_slo_spec=_SERVE_SLO_SPEC,
     )
 
     # --- the fleet: mixed-size tenant instances (mass_like_24-class) --------
@@ -1395,6 +1594,35 @@ def serve_bench(smoke_mode: bool = False) -> int:
         worst_dev = max(worst_dev, float(np.abs(res.allocation - ref.allocation).max()))
     if worst_dev > 1e-3:
         failures.append(f"served allocation deviates {worst_dev:.2e} > 1e-3 vs serial")
+
+    # --- graftscope sojourn decomposition: the parts must explain the whole.
+    # Every audit carries queue-wait / prepare / solve / audit components
+    # (batch-window wait is a sub-component of solve); the acceptance
+    # contract is that they sum to within 5 % of the measured sojourn.
+    sojourn_gap_pct = 0.0
+    memory_stamps = 0
+    for res in results:
+        soj = res.audit.get("sojourn")
+        if not soj:
+            failures.append("a request audit carries no sojourn block")
+            break
+        parts = (
+            soj["queue_wait_s"] + soj["prepare_s"] + soj["solve_s"]
+            + soj["audit_s"]
+        )
+        gap = abs(soj["total_s"] - parts) / max(soj["total_s"], 1e-9)
+        sojourn_gap_pct = max(sojourn_gap_pct, 100.0 * gap)
+        memory_stamps += 1 if "memory" in res.audit else 0
+    if sojourn_gap_pct > 5.0:
+        failures.append(
+            f"sojourn components explain only {100 - sojourn_gap_pct:.1f}% "
+            "of measured request sojourn (gap > 5%)"
+        )
+    if memory_stamps != len(results):
+        failures.append(
+            f"only {memory_stamps}/{len(results)} request audits carry the "
+            "obs_memory ledger stamp"
+        )
 
     # --- occupancy: cross-request solves per engine dispatch ---------------
     bstats = svc.batcher.stats()
@@ -1475,7 +1703,60 @@ def serve_bench(smoke_mode: bool = False) -> int:
             failures.append("serve trace recorded no spans (obs_trace inert)")
         if "graftserve_requests_total" not in prom_text:
             failures.append("prometheus dump missing graftserve_requests_total")
+
+    # --- graftscope SLO engine: committed-spec evaluation + report artifact
+    slo_report = svc.slo.evaluate() if svc.slo is not None else None
+    if slo_report is None:
+        failures.append("SLO engine not armed despite committed obs_slo_spec")
+    else:
+        if not slo_report["slo_ok"]:
+            failures.append(
+                f"committed SLO spec violated: {slo_report['breaches']}"
+            )
+        slo_path = os.path.join(
+            art_dir, "SLO_report_smoke.json" if smoke_mode else "SLO_report.json"
+        )
+        try:
+            with open(slo_path, "w", encoding="utf-8") as fh:
+                json.dump(
+                    {"spec": _SERVE_SLO_SPEC, "report": slo_report},
+                    fh, indent=1,
+                )
+                fh.write("\n")
+        except OSError:
+            slo_path = "(unwritable)"
+        obs_stamp["slo_ok"] = slo_report["slo_ok"]
+        obs_stamp["slo_events"] = slo_report["events"]
+        obs_stamp["slo_file"] = os.path.basename(str(slo_path))
+    obs_stamp["sojourn_gap_pct"] = round(sojourn_gap_pct, 2)
     svc.shutdown()
+
+    if smoke_mode:
+        # synthetic-breach drill: ``queue_stall:1.0`` stalls every request
+        # 0.25 s pre-execution, so a 100 ms p99 objective must breach —
+        # asserts the ("slo", …) stream end to end (engine → open channels)
+        drill_cfg = cfg.replace(
+            fault_sites="queue_stall:1.0", fault_seed=7,
+            obs_slo_spec="latency_p99:100ms,error_rate:0.5",
+            obs_trace=False, obs_memory=None, obs_metrics_interval_s=0.0,
+        )
+        drill = SelectionService(drill_cfg)
+        drill_chans = [
+            drill.submit(SelectionRequest(instance=inst, tenant=tenant))
+            for inst, tenant in specs[:3]
+        ]
+        breach_events = 0
+        for ch in drill_chans:
+            ch.result(timeout=600)
+            breach_events += sum(
+                1 for kind, _p in ch.events(timeout=1) if kind == "slo"
+            )
+        drill.shutdown()
+        obs_stamp["slo_breach_events"] = breach_events
+        if breach_events < 1:
+            failures.append(
+                "fault-injected drill streamed no ('slo', …) breach event"
+            )
 
     lat.sort()
     p50 = lat[len(lat) // 2]
@@ -2404,6 +2685,8 @@ if __name__ == "__main__":
         raise SystemExit(dist_bench(smoke_mode="--smoke" in sys.argv))
     if "--kernels" in sys.argv:
         raise SystemExit(kernels_bench(smoke_mode="--smoke" in sys.argv))
+    if "--roofline" in sys.argv:
+        raise SystemExit(roofline_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         raise SystemExit(smoke())
     main()

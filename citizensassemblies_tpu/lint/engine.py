@@ -174,7 +174,7 @@ def iter_python_files(paths: Iterable[Path]) -> List[Path]:
 
 
 def all_rules():
-    """The registered rule set, R1..R10 (R0 is emitted by the engine itself)."""
+    """The registered rule set, R1..R11 (R0 is emitted by the engine itself)."""
     from citizensassemblies_tpu.lint.config_rule import ConfigKnobRule
     from citizensassemblies_tpu.lint.rules import (
         CoreSpanRule,
@@ -184,6 +184,7 @@ def all_rules():
         HostSyncInJitRule,
         JitConstructionRule,
         MeshHygieneRule,
+        MetricHygieneRule,
         ThreadDisciplineRule,
         TracerBranchRule,
     )
@@ -199,6 +200,7 @@ def all_rules():
         CoreSpanRule(),
         FaultSiteRule(),
         MeshHygieneRule(),
+        MetricHygieneRule(),
     ]
 
 

@@ -1391,3 +1391,194 @@ class MeshHygieneRule:
                 )
             )
         return out
+
+
+class MetricHygieneRule:
+    """R11 — every metric name literal must come from the catalogue.
+
+    ``obs/catalog.py`` is the single registry of metric series names
+    (``METRIC_SERIES``) and dynamic-prefix families (``METRIC_PREFIXES``).
+    The failure mode this kills: a typo'd counter name silently mints a
+    brand-new series, dashboards keep reading the old (now frozen) one, and
+    the regression goes unobserved. Per emission call site
+    (``log.count``/``gauge``/``timer`` and registry
+    ``counter``/``gauge``/``timer``/``histogram``), the rule enforces:
+
+    * the name is a string LITERAL — or an ``IfExp`` choosing between
+      literals, or an f-string whose literal LEADING fragment is a
+      registered dynamic prefix (the per-key families: fault sites, ladder
+      rungs, schedule buckets);
+    * every literal so reachable is catalogued (exact ``METRIC_SERIES``
+      membership, or a ``METRIC_PREFIXES`` prefix).
+
+    ``count`` is a generic method name (``itertools.count``,
+    ``str.count``), so it is only claimed on log-like receivers
+    (``log`` / ``*_log`` / ``metrics`` / ``*_metrics`` tails); the
+    distinctive emission methods are claimed on any receiver. The metrics
+    plumbing that forwards caller-supplied names (``utils/logging.py``,
+    ``obs/metrics.py``) and the catalogue itself are exempt, as are test
+    modules (tests mint ad-hoc names for fixtures).
+    """
+
+    rule_id = "R11"
+    name = "metric-hygiene"
+    description = "metric name literals must be registered in obs/catalog.py"
+
+    #: distinctive emission methods, claimed on ANY receiver
+    _METHODS = ("gauge", "timer", "counter", "histogram")
+    #: generic method, claimed only on log-like receivers
+    _COUNT_TAILS = ("log", "metrics")
+
+    _EXEMPT = ("obs/catalog.py", "obs/metrics.py", "utils/logging.py")
+
+    @staticmethod
+    def _catalogue(
+        modules: Sequence[ModuleSource],
+    ) -> Optional[Tuple[Set[str], Set[str]]]:
+        """(series, prefixes) parsed statically from obs/catalog.py, or
+        None when the catalogue module is outside the lint scope."""
+        for mod in modules:
+            if mod.path.name != "catalog.py" or "obs" not in str(mod.path):
+                continue
+            series: Set[str] = set()
+            prefixes: Set[str] = set()
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (
+                    [node.target] if isinstance(node, ast.AnnAssign)
+                    else node.targets
+                )
+                names = {
+                    t.id for t in targets if isinstance(t, ast.Name)
+                }
+                if "METRIC_SERIES" in names and isinstance(
+                    node.value, ast.Dict
+                ):
+                    series = {
+                        k.value
+                        for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+                elif "METRIC_PREFIXES" in names and node.value is not None:
+                    prefixes = {
+                        c.value
+                        for c in ast.walk(node.value)
+                        if isinstance(c, ast.Constant)
+                        and isinstance(c.value, str)
+                    }
+            return series, prefixes
+        return None
+
+    @classmethod
+    def _skip_module(cls, mod: ModuleSource) -> bool:
+        rel = str(mod.path).replace("\\", "/")
+        if any(rel.endswith(e) for e in cls._EXEMPT):
+            return True
+        name = mod.path.name
+        return (
+            "tests" in mod.path.parts
+            or name.startswith("test_")
+            or name == "conftest.py"
+        )
+
+    @staticmethod
+    def _name_literals(node: ast.AST) -> Optional[List[str]]:
+        """All string literals the name expression can evaluate to, or
+        None when a branch is not statically known. IfExp recurses so
+        ``"a" if p else "b"`` contributes both arms."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, ast.IfExp):
+            body = MetricHygieneRule._name_literals(node.body)
+            orelse = MetricHygieneRule._name_literals(node.orelse)
+            if body is None or orelse is None:
+                return None
+            return body + orelse
+        return None
+
+    def check_package(
+        self, modules: Sequence[ModuleSource], readme=None
+    ) -> List[Violation]:
+        catalogue = self._catalogue(modules)
+        if catalogue is None:
+            return []  # catalogue outside the scope: nothing to judge against
+        series, prefixes = catalogue
+        out: List[Violation] = []
+        for mod in modules:
+            if self._skip_module(mod):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Attribute
+                ):
+                    continue
+                method = node.func.attr
+                receiver = dotted(node.func.value)
+                tail = receiver.rsplit(".", 1)[-1] if receiver else ""
+                if method == "count":
+                    if not (
+                        tail in self._COUNT_TAILS
+                        or tail.endswith("_log")
+                        or tail.endswith("_metrics")
+                    ):
+                        continue
+                elif method not in self._METHODS:
+                    continue
+
+                def flag(message: str) -> None:
+                    out.append(
+                        Violation(
+                            path=mod.rel, line=node.lineno,
+                            col=node.col_offset, rule=self.rule_id,
+                            name=self.name, message=message,
+                        )
+                    )
+
+                arg = node.args[0] if node.args else None
+                if arg is None:
+                    for kw in node.keywords:
+                        if kw.arg == "name":
+                            arg = kw.value
+                            break
+                if arg is None:
+                    continue  # no name operand (not an emission call)
+                if isinstance(arg, ast.JoinedStr):
+                    # dynamic family: the literal LEADING fragment must be
+                    # a registered prefix
+                    lead = (
+                        arg.values[0].value
+                        if arg.values
+                        and isinstance(arg.values[0], ast.Constant)
+                        and isinstance(arg.values[0].value, str)
+                        else ""
+                    )
+                    if not any(lead.startswith(p) for p in prefixes):
+                        flag(
+                            f"f-string metric name leads with '{lead}', "
+                            "which no METRIC_PREFIXES family covers — "
+                            "register the prefix in obs/catalog.py or use "
+                            "a catalogued literal"
+                        )
+                    continue
+                literals = self._name_literals(arg)
+                if literals is None:
+                    flag(
+                        f"{method}() metric name is computed — a name the "
+                        "catalogue cannot see can silently mint a new "
+                        "series; use a literal (or an IfExp over literals) "
+                        "registered in obs/catalog.py"
+                    )
+                    continue
+                for lit in literals:
+                    if lit not in series and not any(
+                        lit.startswith(p) for p in prefixes
+                    ):
+                        flag(
+                            f"metric name '{lit}' is not registered in "
+                            "obs/catalog.py METRIC_SERIES (or a "
+                            "METRIC_PREFIXES family) — register the series "
+                            "(with a help line) before emitting it"
+                        )
+        return out

@@ -14,15 +14,45 @@ the opt-in device-sampling mode):
   registered IR core's entry point wraps (graftlint R8), tri-stated by
   ``Config.obs_trace``;
 * ``obs.trend`` — the ``bench.py --trend`` regression gate over the
-  committed BENCH_*.json trajectory.
+  committed BENCH_*.json trajectory;
+* ``obs.memory`` — graftscope's per-phase device-memory ledger (live-array
+  bytes, HBM high watermark, per-owner cache attribution), tri-stated by
+  ``Config.obs_memory``;
+* ``obs.roofline`` — joins measured dispatch spans against the committed
+  ``ANALYSIS_BUDGET.json`` flops/bytes for achieved-rate and
+  bytes-/compute-bound attribution (``bench.py --roofline``);
+* ``obs.slo`` — the declarative SLO engine (``Config.obs_slo_spec``) with
+  multi-window burn rates and ``("slo", …)`` breach events;
+* ``obs.catalog`` — the metric-series catalogue graftlint R11 enforces;
+* ``python -m citizensassemblies_tpu.obs`` — the offline trace-analysis
+  CLI (critical path, self time, fusion timeline, ``--diff``).
 """
 
+from citizensassemblies_tpu.obs.catalog import (
+    METRIC_PREFIXES,
+    METRIC_SERIES,
+    is_registered,
+)
 from citizensassemblies_tpu.obs.hooks import DispatchScope, dispatch_span
+from citizensassemblies_tpu.obs.memory import (
+    MemoryLedger,
+    ambient_ledger,
+    leak_verdict,
+    owner_attribution,
+    use_ledger,
+)
 from citizensassemblies_tpu.obs.metrics import (
     MetricsRegistry,
     format_counters,
     format_timers,
 )
+from citizensassemblies_tpu.obs.roofline import (
+    RooflineReport,
+    RooflineRow,
+    dispatch_totals,
+    roofline_join,
+)
+from citizensassemblies_tpu.obs.slo import SloEngine, parse_slo_spec
 from citizensassemblies_tpu.obs.trace import (
     TRACE_SCHEMA_VERSION,
     Span,
@@ -58,4 +88,18 @@ __all__ = [
     "TrendReport",
     "collect_series",
     "trend_gate",
+    "METRIC_PREFIXES",
+    "METRIC_SERIES",
+    "is_registered",
+    "MemoryLedger",
+    "ambient_ledger",
+    "leak_verdict",
+    "owner_attribution",
+    "use_ledger",
+    "RooflineReport",
+    "RooflineRow",
+    "dispatch_totals",
+    "roofline_join",
+    "SloEngine",
+    "parse_slo_spec",
 ]

@@ -49,12 +49,26 @@ _INERT = DispatchScope()
 
 @contextmanager
 def dispatch_span(name: str, cfg=None, log=None, **attrs):
+    # graftscope memory ledger: snapshot at the span boundary whenever a
+    # ledger is ambient and ``obs_memory`` is not hard-off. Resolution is
+    # one ContextVar read; the hard-off path is one attribute read.
+    led = None
+    if cfg is None or getattr(cfg, "obs_memory", None) is not False:
+        from citizensassemblies_tpu.obs.memory import ambient_ledger
+
+        led = ambient_ledger()
     if cfg is not None and getattr(cfg, "obs_trace", None) is False:
         yield _INERT
+        if led is not None:
+            led.snapshot(name)
         return
     tr = _resolve(log)
     if tr is None:
+        if led is None:
+            yield _INERT
+            return
         yield _INERT
+        led.snapshot(name)
         return
     scope = DispatchScope()
     # pod runs: every span carries its process index so merged multi-host
@@ -71,3 +85,5 @@ def dispatch_span(name: str, cfg=None, log=None, **attrs):
             jax.block_until_ready(scope.out)
             if sp is not None:
                 sp.attrs["sampled"] = True
+    if led is not None:
+        led.snapshot(name)

@@ -1,0 +1,213 @@
+"""graftscope memory ledger: per-phase device-memory accounting.
+
+The ledger answers the question the span tracer cannot: not *when* a phase
+ran but *what it left resident*. Three sources, all read-only:
+
+* ``jax.live_arrays()`` — every live device array's ``nbytes``, the
+  backend-independent number (works on the CPU CI backend where
+  ``memory_stats`` is absent);
+* ``device.memory_stats()`` — allocator truth (``bytes_in_use``,
+  ``peak_bytes_in_use``) on backends that expose it (TPU/GPU); the per-run
+  HBM high watermark is the max over both sources;
+* the :class:`~citizensassemblies_tpu.utils.memo.LRU` instance registry —
+  every bounded cache in the process (tenant warm slots, ELL packs, result
+  memos, jit memo tables), walked shallowly to attribute resident bytes to
+  the owning subsystem or tenant.
+
+Tri-stated by ``Config.obs_memory`` exactly like ``obs_trace``:
+
+* ``False`` — hard off: the dispatch hook does one attribute read and
+  never touches this module; bit-identical, zero allocation;
+* ``None`` (auto) — snapshots record whenever a caller installs a ledger
+  (:func:`use_ledger`), e.g. the bench around its warm flagship reps;
+* ``True`` — the service additionally creates a per-request ledger and
+  stamps its summary (``memory`` block) onto the request audit.
+
+Snapshots are pure observation — no transfers, no deletes, no numerics —
+which is what keeps the obs-off/on bitwise-identity contract testable.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Dict, List, Optional
+
+MEMORY_SCHEMA_VERSION = 1
+
+_AMBIENT: ContextVar[Optional["MemoryLedger"]] = ContextVar(
+    "citizens_memory_ledger", default=None
+)
+
+
+def ambient_ledger() -> Optional["MemoryLedger"]:
+    """The ledger installed on this (thread's) context, if any."""
+    return _AMBIENT.get()
+
+
+@contextmanager
+def use_ledger(ledger: Optional["MemoryLedger"]):
+    """Install ``ledger`` as the ambient snapshot target for the block."""
+    token = _AMBIENT.set(ledger)
+    try:
+        yield ledger
+    finally:
+        _AMBIENT.reset(token)
+
+
+def ledger_enabled(cfg) -> bool:
+    """The dispatch-hook gate: ``obs_memory`` hard-off wins over an
+    installed ledger (mirrors the ``obs_trace`` contract)."""
+    return cfg is None or getattr(cfg, "obs_memory", None) is not False
+
+
+def live_array_bytes() -> Dict[str, int]:
+    """Total bytes and count of live jax arrays (skips deleted handles)."""
+    import jax
+
+    total = 0
+    count = 0
+    for arr in jax.live_arrays():
+        try:
+            if arr.is_deleted():
+                continue
+            total += int(arr.nbytes)
+            count += 1
+        except Exception:  # noqa: BLE001 - a dying handle is not an error
+            continue
+    return {"live_bytes": total, "live_arrays": count}
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Summed allocator stats across local devices; ``{}`` on backends
+    (CPU) that expose none — callers treat the keys as optional."""
+    import jax
+
+    in_use = 0
+    peak = 0
+    seen = False
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 - backend without allocator stats
+            stats = None
+        if not stats:
+            continue
+        seen = True
+        in_use += int(stats.get("bytes_in_use", 0))
+        peak += int(stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)))
+    return {"hbm_bytes_in_use": in_use, "hbm_peak_bytes": peak} if seen else {}
+
+
+def _shallow_nbytes(value: Any, depth: int = 3) -> int:
+    """Bytes held by arrays reachable from ``value`` within ``depth`` hops
+    through containers/dataclass fields. Shallow on purpose: cache entries
+    are small pytrees (packs, warm slots, result records), and a bounded
+    walk cannot be wedged by cyclic or exotic objects."""
+    if value is None or depth < 0:
+        return 0
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    if isinstance(value, dict):
+        return sum(_shallow_nbytes(v, depth - 1) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(_shallow_nbytes(v, depth - 1) for v in value)
+    fields = getattr(value, "__dict__", None)
+    if isinstance(fields, dict):
+        return sum(_shallow_nbytes(v, depth - 1) for v in fields.values())
+    return 0
+
+
+def owner_attribution() -> Dict[str, int]:
+    """Resident bytes per owning subsystem, from the LRU instance registry.
+
+    Keys are the LRU entry owners (``tenant:<name>`` for session state) or
+    the cache's own name; values are the shallow byte totals of the cached
+    entries. This is attribution of the *cached* population — the working
+    set a request allocates and frees inside one solve shows up in the
+    snapshot deltas instead.
+    """
+    from citizensassemblies_tpu.utils.memo import live_caches
+
+    by_owner: Dict[str, int] = {}
+    for cache in live_caches():
+        try:
+            items = [(k, cache._d[k]) for k in list(cache._d)]
+        except Exception:  # noqa: BLE001 - cache mutating under us
+            continue
+        for key, entry in items:
+            owner = cache._owners.get(key) or cache.name or "unnamed"
+            by_owner[owner] = by_owner.get(owner, 0) + _shallow_nbytes(entry)
+    return by_owner
+
+
+class MemoryLedger:
+    """Per-run (or per-request) accountant of device-memory snapshots.
+
+    ``snapshot(phase)`` records one row; :meth:`stamp` summarizes the run
+    for audit/bench blocks; :meth:`series` exposes the live-bytes
+    trajectory for the leak sentinel.
+    """
+
+    def __init__(self, name: str = "run", attribute_owners: bool = True):
+        self.name = name
+        self.attribute_owners = attribute_owners
+        self.records: List[Dict[str, Any]] = []
+        self.high_watermark_bytes = 0
+        self._t0 = time.perf_counter()
+
+    def snapshot(self, phase: str) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "phase": phase,
+            "t_s": round(time.perf_counter() - self._t0, 6),
+        }
+        rec.update(live_array_bytes())
+        rec.update(device_memory_stats())
+        resident = max(rec["live_bytes"], rec.get("hbm_bytes_in_use", 0))
+        peak = max(resident, rec.get("hbm_peak_bytes", 0))
+        if peak > self.high_watermark_bytes:
+            self.high_watermark_bytes = peak
+        self.records.append(rec)
+        return rec
+
+    def series(self, phase: Optional[str] = None) -> List[int]:
+        """Live-byte trajectory, optionally filtered to one phase name."""
+        return [
+            r["live_bytes"]
+            for r in self.records
+            if phase is None or r["phase"] == phase
+        ]
+
+    def stamp(self) -> Dict[str, Any]:
+        """The ``memory`` block for bench rows and service audit stamps."""
+        out: Dict[str, Any] = {
+            "schema_version": MEMORY_SCHEMA_VERSION,
+            "ledger": self.name,
+            "snapshots": len(self.records),
+            "high_watermark_bytes": self.high_watermark_bytes,
+        }
+        if self.records:
+            last = self.records[-1]
+            out["live_bytes_last"] = last["live_bytes"]
+            out["live_arrays_last"] = last["live_arrays"]
+            if "hbm_bytes_in_use" in last:
+                out["hbm_bytes_in_use"] = last["hbm_bytes_in_use"]
+        if self.attribute_owners:
+            owners = owner_attribution()
+            out["owners"] = {
+                k: owners[k] for k in sorted(owners, key=owners.get, reverse=True)
+            }
+        return out
+
+
+def leak_verdict(series: List[int]) -> bool:
+    """True (leak) when live bytes grew STRICTLY monotonically across ≥ 3
+    warm repetitions — a warm rep re-entering compiled code should reach a
+    steady state; unbroken growth means something accretes per call. One
+    flat or descending step anywhere clears the verdict (caches settling
+    on their cap plateau are not leaks)."""
+    if len(series) < 3:
+        return False
+    return all(b > a for a, b in zip(series, series[1:]))

@@ -1,0 +1,186 @@
+"""graftscope roofline attribution: join measured spans to static budgets.
+
+graftcheck-IR (``lint/ir.py``) knows the FLOPs and bytes every registered
+core's compiled program touches (``ANALYSIS_BUDGET.json``); grafttrace
+knows how long each dispatch took (``dispatch_span`` wall time, device-
+sampled). Neither alone says whether a core runs at a sensible fraction of
+the machine — joined, they do: achieved FLOP/s, achieved B/s, and the
+arithmetic intensity that places each core on the roofline, with a
+bytes-bound/compute-bound verdict against the machine-balance ridge
+(``Config.obs_roofline_ridge``, FLOPs per byte). PDHG-style solvers are
+memory-bound by construction (PAPERS.md: PDLP throughput tracks memory
+bandwidth), so the verdict names the resource a future kernel PR must
+actually move.
+
+The join is exact by construction: graftlint R8 pins every registered
+core's ``dispatch_span`` name to its manifest name, and the microbench
+(``bench.py --roofline``) executes each core at the SAME representative
+shapes its budget was measured at — so budget FLOPs over measured seconds
+is a true rate, not a shape-mismatched estimate. A dispatch span whose
+name has no budget entry is a JOIN MISS and fails the smoke: the span
+fired from a core the static layer cannot see.
+
+Stdlib-only module: the jax-touching microbench lives in ``bench.py``;
+this file only aggregates spans and does arithmetic, so the trace CLI and
+tests run without a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+ROOFLINE_SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class RooflineRow:
+    """One core's placement on the roofline for one run."""
+
+    core: str
+    calls: int
+    seconds: float  # summed device-sampled wall time across calls
+    flops: float  # per-call, from the committed budget
+    bytes: float  # per-call, from the committed budget
+    achieved_gflops_s: float
+    achieved_gbytes_s: float
+    intensity_flops_per_byte: float
+    bound: str  # "bytes-bound" | "compute-bound"
+    sampled: bool  # True when every call blocked on its outputs
+
+    @property
+    def finite(self) -> bool:
+        return (
+            self.seconds > 0.0
+            and self.achieved_gflops_s >= 0.0
+            and self.achieved_gbytes_s >= 0.0
+            and self.achieved_gflops_s == self.achieved_gflops_s  # not NaN
+        )
+
+
+@dataclasses.dataclass
+class RooflineReport:
+    rows: List[RooflineRow]
+    misses: List[str]  # dispatch-span names with no budget entry
+    unexecuted: List[str]  # budgeted cores that never fired (informational)
+    ridge_flops_per_byte: float
+    budget_provenance: Dict[str, Any]
+
+    @property
+    def ok(self) -> bool:
+        return not self.misses and all(r.finite for r in self.rows)
+
+    def as_json(self) -> dict:
+        return {
+            "schema_version": ROOFLINE_SCHEMA_VERSION,
+            "roofline_ok": self.ok,
+            "ridge_flops_per_byte": self.ridge_flops_per_byte,
+            "budget": self.budget_provenance,
+            "misses": list(self.misses),
+            "unexecuted": list(self.unexecuted),
+            "rows": {
+                r.core: {
+                    "calls": r.calls,
+                    "seconds": r.seconds,
+                    "flops_per_call": r.flops,
+                    "bytes_per_call": r.bytes,
+                    "achieved_gflops_s": r.achieved_gflops_s,
+                    "achieved_gbytes_s": r.achieved_gbytes_s,
+                    "intensity_flops_per_byte": r.intensity_flops_per_byte,
+                    "bound": r.bound,
+                    "sampled": r.sampled,
+                }
+                for r in self.rows
+            },
+        }
+
+    def trend_detail(self) -> Dict[str, Dict[str, float]]:
+        """``{"roofline_<core>": {"seconds": …}}`` rows for the committed
+        ``ROOFLINE_r*.json`` family — the trend loader's ``_ROW_RE`` only
+        admits ``[A-Za-z0-9_]`` names, so core dots become underscores."""
+        return {
+            "roofline_" + r.core.replace(".", "_"): {
+                "seconds": round(r.seconds, 6)
+            }
+            for r in self.rows
+        }
+
+
+def dispatch_totals(tracers: Sequence) -> Dict[str, Dict[str, Any]]:
+    """Aggregate ``kind="dispatch"`` spans by name across tracers:
+    ``{name: {"calls", "seconds", "sampled"}}``. ``sampled`` stays True
+    only if every call blocked on device outputs (``sampled`` span attr) —
+    an unsampled call means the span timed host enqueue, not execution."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for tracer in tracers:
+        for sp in tracer.spans():
+            if sp.attrs.get("kind") != "dispatch" or sp.t1 is None:
+                continue
+            agg = out.setdefault(
+                sp.name, {"calls": 0, "seconds": 0.0, "sampled": True}
+            )
+            agg["calls"] += 1
+            agg["seconds"] += sp.duration
+            agg["sampled"] = agg["sampled"] and bool(sp.attrs.get("sampled"))
+    return out
+
+
+def roofline_join(
+    tracers: Sequence,
+    budget_path=None,
+    ridge: Optional[float] = None,
+) -> RooflineReport:
+    """Join the tracers' dispatch spans against the committed budget."""
+    from citizensassemblies_tpu.lint.ir import (
+        BUDGET_PATH,
+        budget_provenance,
+        load_budget,
+    )
+
+    if ridge is None:
+        from citizensassemblies_tpu.utils.config import default_config
+
+        ridge = float(default_config().obs_roofline_ridge)
+    path = Path(budget_path) if budget_path is not None else BUDGET_PATH
+    budgets, _tol = load_budget(path)
+
+    totals = dispatch_totals(tracers)
+    rows: List[RooflineRow] = []
+    misses: List[str] = []
+    for name in sorted(totals):
+        agg = totals[name]
+        budget = budgets.get(name)
+        if budget is None:
+            misses.append(name)
+            continue
+        flops = float(budget.get("flops", 0.0))
+        nbytes = float(budget.get("bytes", 0.0))
+        seconds = float(agg["seconds"])
+        total_flops = flops * agg["calls"]
+        total_bytes = nbytes * agg["calls"]
+        gflops_s = (total_flops / seconds) / 1e9 if seconds > 0 else float("nan")
+        gbytes_s = (total_bytes / seconds) / 1e9 if seconds > 0 else float("nan")
+        intensity = flops / nbytes if nbytes > 0 else float("inf")
+        rows.append(
+            RooflineRow(
+                core=name,
+                calls=agg["calls"],
+                seconds=round(seconds, 6),
+                flops=flops,
+                bytes=nbytes,
+                achieved_gflops_s=round(gflops_s, 4),
+                achieved_gbytes_s=round(gbytes_s, 4),
+                intensity_flops_per_byte=round(intensity, 4),
+                bound="bytes-bound" if intensity < ridge else "compute-bound",
+                sampled=bool(agg["sampled"]),
+            )
+        )
+    unexecuted = sorted(set(budgets) - set(totals))
+    return RooflineReport(
+        rows=rows,
+        misses=misses,
+        unexecuted=unexecuted,
+        ridge_flops_per_byte=float(ridge),
+        budget_provenance=budget_provenance(path),
+    )

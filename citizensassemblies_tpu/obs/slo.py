@@ -1,0 +1,210 @@
+"""graftscope SLO engine: declarative objectives over the serving metrics.
+
+graftserve reports raw p50/p99 and failure counters; an operator needs the
+next layer: "is tenant X inside its latency objective, and how fast is it
+burning error budget?" This module turns ``Config.obs_slo_spec`` — a
+one-line declarative spec like ``latency_p99:20s,error_rate:0.01`` — into
+that evaluation:
+
+* **objectives** — ``latency_pNN:<seconds>`` (the NN-th percentile of
+  request sojourn must stay under the target) and ``error_rate:<frac>``
+  (the failure fraction must stay under the target). A ``tenant/``-prefixed
+  entry (``civic/latency_p99:5s``) overrides the global objective for that
+  tenant; every tenant is additionally evaluated against the global
+  entries, so per-tenant SLOs need no per-tenant spec lines.
+* **multi-window burn rate** — for each objective and each window (1 min /
+  5 min / 1 h by default), the ratio of observed badness to the budget the
+  objective allows: error burn = observed error rate / target rate;
+  latency burn = fraction of requests over the latency target / allowed
+  tail fraction (1% for p99). Burn > 1 means the budget is being consumed
+  faster than sustainable over that window — the standard multi-window
+  alerting shape, computed here rather than in an external system.
+* **breaches** — an objective whose full-window observation violates its
+  target. The service streams each breach transition as a ``("slo", …)``
+  event into every open ResultChannel and counts it
+  (``graftserve_slo_breach_total``).
+
+The engine is stdlib-only and lock-guarded (service worker threads record
+completions concurrently); the event history is bounded by the largest
+window, so a long-lived service cannot grow it without bound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+SLO_SCHEMA_VERSION = 1
+
+#: default burn-rate windows (seconds): fast / medium / slow
+DEFAULT_WINDOWS: Tuple[float, ...] = (60.0, 300.0, 3600.0)
+
+_LATENCY_RE = re.compile(r"^latency_p(\d{1,2})$")
+
+
+def _parse_target(objective: str, raw: str) -> float:
+    """Target value with unit handling: ``20s``/``150ms`` for latency
+    objectives, a bare fraction for rates."""
+    raw = raw.strip()
+    if raw.endswith("ms"):
+        return float(raw[:-2]) / 1e3
+    if raw.endswith("s"):
+        return float(raw[:-1])
+    return float(raw)
+
+
+def parse_slo_spec(spec: str) -> Dict[Optional[str], Dict[str, float]]:
+    """``"latency_p99:20s,error_rate:0.01,civic/latency_p99:5s"`` →
+    ``{None: {...global...}, "civic": {...overrides...}}``. Raises
+    ``ValueError`` on malformed entries — a typo'd SLO spec must fail the
+    service at construction, not silently never gate."""
+    out: Dict[Optional[str], Dict[str, float]] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ValueError(f"SLO entry {entry!r} has no ':<target>'")
+        name, raw = entry.split(":", 1)
+        tenant: Optional[str] = None
+        if "/" in name:
+            tenant, name = name.split("/", 1)
+        name = name.strip()
+        if name != "error_rate" and not _LATENCY_RE.match(name):
+            raise ValueError(
+                f"unknown SLO objective {name!r} (want latency_pNN or error_rate)"
+            )
+        out.setdefault(tenant, {})[name] = _parse_target(name, raw)
+    return out
+
+
+def _percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) — the conservative estimator
+    for small serving samples; matches the bench's quantile convention."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(0, min(len(ordered) - 1, int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[rank]
+
+
+@dataclasses.dataclass
+class SloEvent:
+    t: float
+    tenant: str
+    latency_s: float
+    ok: bool
+
+
+class SloEngine:
+    """Evaluates a parsed spec over a bounded stream of request outcomes."""
+
+    def __init__(
+        self,
+        spec: str,
+        windows: Tuple[float, ...] = DEFAULT_WINDOWS,
+        clock=time.monotonic,
+    ):
+        self.spec = parse_slo_spec(spec)
+        self.windows = tuple(sorted(windows))
+        self._clock = clock
+        self._events: List[SloEvent] = []
+        self._lock = threading.Lock()
+        self._breached: set = set()  # (tenant, objective) currently breaching
+
+    def record(self, tenant: str, latency_s: float, ok: bool) -> None:
+        """One terminal request outcome (success, failure, or deadline)."""
+        now = self._clock()
+        horizon = now - self.windows[-1]
+        with self._lock:
+            self._events.append(
+                SloEvent(t=now, tenant=tenant, latency_s=float(latency_s), ok=ok)
+            )
+            # trim anything older than the slowest window (bounded history)
+            if self._events and self._events[0].t < horizon:
+                self._events = [e for e in self._events if e.t >= horizon]
+
+    def _objectives_for(self, tenant: str) -> Dict[str, float]:
+        merged = dict(self.spec.get(None, {}))
+        merged.update(self.spec.get(tenant, {}))
+        return merged
+
+    @staticmethod
+    def _observe(
+        events: List[SloEvent], objective: str, target: float
+    ) -> Tuple[float, float]:
+        """(observed value, burn rate) of one objective over ``events``."""
+        if objective == "error_rate":
+            observed = sum(1 for e in events if not e.ok) / max(len(events), 1)
+            return observed, observed / max(target, 1e-12)
+        q = float(_LATENCY_RE.match(objective).group(1))
+        lat = [e.latency_s for e in events]
+        observed = _percentile(lat, q)
+        allowed_tail = max(1.0 - q / 100.0, 1e-12)
+        over = sum(1 for v in lat if v > target) / max(len(lat), 1)
+        return observed, over / allowed_tail
+
+    def evaluate(self) -> Dict[str, Any]:
+        """The full SLO report: per tenant × objective, the full-history
+        observation, per-window burn rates, and the breach verdict."""
+        with self._lock:
+            events = list(self._events)
+        now = self._clock()
+        tenants = sorted({e.tenant for e in events})
+        report: Dict[str, Any] = {
+            "schema_version": SLO_SCHEMA_VERSION,
+            "spec": {
+                (t if t is not None else "*"): dict(objs)
+                for t, objs in self.spec.items()
+            },
+            "windows_s": list(self.windows),
+            "events": len(events),
+            "tenants": {},
+            "breaches": [],
+        }
+        for tenant in tenants:
+            tenant_events = [e for e in events if e.tenant == tenant]
+            objectives = self._objectives_for(tenant)
+            tenant_block: Dict[str, Any] = {}
+            for objective, target in sorted(objectives.items()):
+                observed, _burn = self._observe(tenant_events, objective, target)
+                burns = {}
+                for win in self.windows:
+                    recent = [e for e in tenant_events if e.t >= now - win]
+                    if recent:
+                        _obs, burn = self._observe(recent, objective, target)
+                        burns[f"{int(win)}s"] = round(burn, 4)
+                ok = observed <= target
+                tenant_block[objective] = {
+                    "target": target,
+                    "observed": round(observed, 6),
+                    "ok": ok,
+                    "burn_rates": burns,
+                }
+                if not ok:
+                    report["breaches"].append(
+                        {
+                            "tenant": tenant,
+                            "objective": objective,
+                            "target": target,
+                            "observed": round(observed, 6),
+                            "burn_rates": burns,
+                        }
+                    )
+            report["tenants"][tenant] = tenant_block
+        report["slo_ok"] = not report["breaches"]
+        return report
+
+    def new_breaches(self) -> List[Dict[str, Any]]:
+        """Breaches that TRANSITIONED since the last call — what the service
+        streams as ``("slo", …)`` events (steady-state breaching does not
+        re-emit every request; recovery re-arms the transition)."""
+        report = self.evaluate()
+        current = {(b["tenant"], b["objective"]): b for b in report["breaches"]}
+        with self._lock:
+            fresh = [current[k] for k in sorted(current) if k not in self._breached]
+            self._breached = set(current)
+        return fresh

@@ -48,6 +48,7 @@ _OFFLINE_RE = re.compile(r"BENCH_r(\d+)\.json$")
 _DETAIL_RE = re.compile(r"BENCH_detail_r(\d+)\.json$")
 _SERVE_RE = re.compile(r"BENCH_serve_r(\d+)\.json$")
 _KERNELS_RE = re.compile(r"BENCH_kernels_r(\d+)\.json$")
+_ROOFLINE_RE = re.compile(r"ROOFLINE_r(\d+)\.json$")
 
 
 @dataclasses.dataclass
@@ -191,6 +192,14 @@ def collect_series(root) -> Tuple[Dict[str, List[Tuple[int, float]]], List[int]]
         # kernel microbench family (bench.py --kernels): same
         # {"detail": {row: {"seconds": …}}} schema as the detail files
         m = _KERNELS_RE.search(path.name)
+        if m:
+            rows = _load_offline(path)
+            if rows:
+                by_round.setdefault(int(m.group(1)), {}).update(rows)
+    for path in sorted(root.glob("ROOFLINE_r*.json")):
+        # graftscope roofline family (bench.py --roofline): per-core
+        # dispatch seconds under {"detail": {"roofline_<core>": …}}
+        m = _ROOFLINE_RE.search(path.name)
         if m:
             rows = _load_offline(path)
             if rows:

@@ -136,7 +136,15 @@ class CrossRequestBatcher:
             dispatched = False
             try:
                 if self.window_s > 0:
-                    time.sleep(self.window_s)  # GIL released; followers join
+                    # the leader's share of the fusion window — timed as
+                    # "batch_window" so the sojourn decomposition and the
+                    # trace CLI's fusion timeline see it (followers time
+                    # their whole coupled wait under the same name)
+                    if log is not None:
+                        with log.timer("batch_window"):
+                            time.sleep(self.window_s)  # GIL released
+                    else:
+                        time.sleep(self.window_s)  # GIL released; followers join
                 # chaos: the leader "dies" after claiming the group, before
                 # dispatch — the exact hang the follower watchdog exists for
                 inject.raise_if("batcher_leader_death", log)
@@ -157,7 +165,11 @@ class CrossRequestBatcher:
                         self._leader_threads.pop(key, None)
                         self._stats["leader_deaths"] += 1
         else:
-            self._follower_wait(key, pend, cfg)
+            if pend.log is not None:
+                with pend.log.timer("batch_window"):
+                    self._follower_wait(key, pend, cfg)
+            else:
+                self._follower_wait(key, pend, cfg)
         if pend.error is not None:
             raise pend.error
         return pend.results

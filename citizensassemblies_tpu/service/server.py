@@ -223,6 +223,18 @@ class SelectionService:
         #: rejection; running requests complete (or are deadline-bounded)
         self._futures: Dict[str, Tuple[Any, ResultChannel]] = {}
         self._closed = False
+        # --- graftscope SLO engine (obs/slo.py) ----------------------------
+        #: built from Config.obs_slo_spec when non-empty: every terminal
+        #: request outcome is recorded, breach TRANSITIONS are streamed as
+        #: ("slo", …) events into every open channel and counted
+        #: (graftserve_slo_breach_total); a malformed spec fails here, at
+        #: construction, not silently at evaluation time
+        self.slo = None
+        slo_spec = str(getattr(self.cfg, "obs_slo_spec", "") or "")
+        if slo_spec:
+            from citizensassemblies_tpu.obs.slo import SloEngine
+
+            self.slo = SloEngine(slo_spec)
 
     # --- public API ---------------------------------------------------------
 
@@ -253,7 +265,11 @@ class SelectionService:
         with self._lock:
             self._channels[rid] = channel
         self._ensure_snapshot_loop()
-        fut = self._pool.submit(self._run_request, request, rid, channel)
+        # the submission timestamp rides into the worker so the sojourn
+        # decomposition can attribute queue wait (worker pickup − submit)
+        fut = self._pool.submit(
+            self._run_request, request, rid, channel, time.monotonic()
+        )
         with self._lock:
             self._futures[rid] = (fut, channel)
         return channel
@@ -342,6 +358,8 @@ class SelectionService:
         self._refresh_gauges()
         snap = self.metrics.snapshot()
         snap["service"] = self.stats()
+        if self.slo is not None:
+            snap["slo"] = self.slo.evaluate()
         snap["ts"] = time.time()
         return snap
 
@@ -415,9 +433,39 @@ class SelectionService:
 
         return featurize(request.instance)
 
+    def _slo_record(self, tenant: str, latency_s: float, ok: bool) -> None:
+        """Feed one terminal outcome into the SLO engine and stream any
+        breach TRANSITIONS into every open channel (steady-state breaching
+        does not re-emit per request; recovery re-arms the transition)."""
+        if self.slo is None:
+            return
+        self.slo.record(tenant, latency_s, ok)
+        breaches = self.slo.new_breaches()
+        if not breaches:
+            return
+        with self._lock:
+            channels = list(self._channels.values())
+        for breach in breaches:
+            self.metrics.counter(
+                "graftserve_slo_breach_total",
+                help="SLO breach transitions per tenant and objective",
+                labelnames=("tenant", "objective"),
+            ).labels(
+                tenant=breach["tenant"], objective=breach["objective"]
+            ).inc()
+            for ch in channels:
+                ch.push("slo", breach)
+
     def _run_request(
-        self, request: SelectionRequest, rid: str, channel: ResultChannel
+        self,
+        request: SelectionRequest,
+        rid: str,
+        channel: ResultChannel,
+        t_submit: Optional[float] = None,
     ) -> None:
+        import contextlib
+
+        from citizensassemblies_tpu.obs.memory import use_ledger
         from citizensassemblies_tpu.robust.inject import (
             FaultInjected,
             FaultInjector,
@@ -430,7 +478,9 @@ class SelectionService:
         )
         from citizensassemblies_tpu.utils.guards import CompilationGuard
 
-        t0 = time.monotonic()
+        t0 = time.monotonic()  # worker pickup; queue wait = t0 - t_submit
+        if t_submit is None:
+            t_submit = t0
         base_cfg = request.cfg or self.cfg
         log = _ChannelLog(channel)
         # --- graftfault per-request machinery (robust/) --------------------
@@ -473,6 +523,15 @@ class SelectionService:
 
                 tracer = Tracer(name=rid, sample_device=True)
                 log.tracer = tracer
+            # graftscope: obs_memory=True gives the request its own memory
+            # ledger — dispatch hooks snapshot at span boundaries while it
+            # is ambient, and the audit stamp carries the summary block
+            ledger = None
+            if getattr(base_cfg, "obs_memory", None) is True:
+                from citizensassemblies_tpu.obs.memory import MemoryLedger
+
+                ledger = MemoryLedger(name=rid)
+                ledger.snapshot("request_start")
             session = self.tenants.session(request.tenant)
             dense, space = self._featurize(request)
             fp = self._fingerprint(request, dense, base_cfg)
@@ -488,18 +547,22 @@ class SelectionService:
                     self._completed += 1
                     self._in_flight -= 1
                 channel.push("progress", f"request {rid}: served from tenant memo")
-                channel.push(
-                    "result",
-                    self._finish(
-                        request, rid, memo_hit, t0, ctx, compiles=0,
-                        from_memo=True,
-                    ),
+                t_memo = time.monotonic()
+                payload = self._finish(
+                    request, rid, memo_hit, t0, ctx, compiles=0,
+                    from_memo=True, sojourn=(t_submit, t_memo, t_memo),
+                    ledger=ledger,
                 )
+                self._slo_record(
+                    request.tenant, time.monotonic() - t_submit, ok=True
+                )
+                channel.push("result", payload)
                 return
             # --- transient-fault retry loop (robust/policy) ----------------
             # each retry backs off exponentially and walks ONE rung down the
             # certified degradation ladder; the deadline bounds the whole
             # loop (a retry that cannot fit its backoff rejects gracefully)
+            t_exec0 = time.monotonic()  # sojourn: the solve window opens
             while True:
                 ctx = self._build_context(
                     request, rid, cfg, log, session, tracer, deadline, retry,
@@ -508,7 +571,13 @@ class SelectionService:
                 try:
                     if deadline is not None:
                         deadline.check("request start", log=log)
-                    with use_context(ctx):
+                    # single-use context managers — rebuilt every retry
+                    mem_scope = (
+                        use_ledger(ledger)
+                        if ledger is not None
+                        else contextlib.nullcontext()
+                    )
+                    with use_context(ctx), mem_scope:
                         with CompilationGuard(name=f"serve_{rid}", log=log) as guard:
                             if tracer is not None:
                                 with tracer.span(
@@ -543,11 +612,13 @@ class SelectionService:
                     if deadline is not None and deadline.remaining() <= delay:
                         deadline.check("retry backoff", log=log)
                     time.sleep(delay)
+            t_exec1 = time.monotonic()  # sojourn: the solve window closes
             session.memo_put((request.algorithm, fp), result)
             session.finish_request(rid)
             success = True
             payload = self._finish(
-                request, rid, result, t0, ctx, compiles=guard.count
+                request, rid, result, t0, ctx, compiles=guard.count,
+                sojourn=(t_submit, t_exec0, t_exec1), ledger=ledger,
             )
             if tracer is not None:
                 with self._lock:
@@ -565,6 +636,11 @@ class SelectionService:
             with self._lock:
                 self._completed += 1
                 self._in_flight -= 1
+            # SLO before the terminal event so a breach this request caused
+            # is visible on its own channel too (events stop at terminal)
+            self._slo_record(
+                request.tenant, time.monotonic() - t_submit, ok=True
+            )
             channel.push("result", payload)
         except DeadlineExceeded as exc:
             # graceful rejection: a typed terminal event carrying a PARTIAL
@@ -578,6 +654,9 @@ class SelectionService:
             with self._lock:
                 self._failed += 1
                 self._in_flight -= 1
+            self._slo_record(
+                request.tenant, time.monotonic() - t_submit, ok=False
+            )
             channel.push(
                 "error",
                 {
@@ -604,6 +683,9 @@ class SelectionService:
             with self._lock:
                 self._failed += 1
                 self._in_flight -= 1
+            self._slo_record(
+                request.tenant, time.monotonic() - t_submit, ok=False
+            )
             channel.push("error", f"{type(exc).__name__}: {exc}")
         finally:
             if ctx is not None:
@@ -729,6 +811,8 @@ class SelectionService:
         ctx: RequestContext,
         compiles: int,
         from_memo: bool = False,
+        sojourn: Optional[Tuple[float, float, float]] = None,
+        ledger=None,
     ) -> RequestResult:
         """Assemble the terminal payload + per-request audit stamp."""
         from citizensassemblies_tpu.utils.memo import memo_evictions_by_owner
@@ -784,6 +868,29 @@ class SelectionService:
                 "dropped_spans": ctx.tracer.dropped,
                 "schema_version": TRACE_SCHEMA_VERSION,
             }
+        # graftscope sojourn decomposition, from MEASURED boundaries:
+        # submit → worker pickup (queue wait) → solve window opens
+        # (prepare: featurize, fingerprint, memo probe) → solve window
+        # closes → audit assembly. The four components partition the
+        # sojourn exactly; batch_window (the cross-request fusion wait,
+        # from the batcher's timer) is a sub-component of the solve window.
+        if sojourn is not None:
+            t_submit, t_x0, t_x1 = sojourn
+            now = time.monotonic()
+            batch_window = float(ctx.log.timers.get("batch_window", 0.0))
+            solve = max(t_x1 - t_x0, 0.0)
+            audit["sojourn"] = {
+                "total_s": round(max(now - t_submit, 0.0), 4),
+                "queue_wait_s": round(max(t0 - t_submit, 0.0), 4),
+                "prepare_s": round(max(t_x0 - t0, 0.0), 4),
+                "solve_s": round(solve, 4),
+                "batch_window_s": round(min(batch_window, solve), 4),
+                "audit_s": round(max(now - t_x1, 0.0), 4),
+            }
+        # graftscope memory ledger: the request's device-memory summary
+        if ledger is not None:
+            ledger.snapshot("request_end")
+            audit["memory"] = ledger.stamp()
         return RequestResult(
             request_id=rid,
             tenant=request.tenant,

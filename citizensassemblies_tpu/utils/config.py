@@ -419,6 +419,29 @@ class Config:
     #: while an injected 2× slowdown is flagged (tests/test_obs.py pins
     #: both).
     obs_trend_tol: float = 1.75
+    #: graftscope memory ledger, tri-state mirroring ``obs_trace``.
+    #: ``False`` = hard off: the dispatch hook does one attribute read and
+    #: never imports the ledger — zero overhead, bit-identical. ``None``
+    #: (auto) = snapshots record whenever a caller installs a
+    #: ``MemoryLedger`` (``obs.memory.use_ledger``), e.g. the bench around
+    #: its warm flagship reps. ``True`` = the service additionally creates
+    #: a per-request ledger and stamps its ``memory`` block (live bytes,
+    #: HBM high watermark, per-owner cache attribution) onto the audit.
+    obs_memory: Optional[bool] = None
+    #: declarative serving SLOs, e.g. ``"latency_p99:20s,error_rate:0.01"``
+    #: (``tenant/objective:target`` entries override per tenant). Empty
+    #: (the default) disables the SLO engine entirely; non-empty makes the
+    #: service evaluate every request outcome, stream breach transitions
+    #: as ``("slo", …)`` channel events, and lets ``bench.py --serve``
+    #: gate on the committed spec.
+    obs_slo_spec: str = ""
+    #: machine-balance ridge (FLOPs per byte) of the roofline verdict: a
+    #: core whose arithmetic intensity sits below it is bytes-bound,
+    #: above it compute-bound. The default is an honest CPU-class balance
+    #: (the CI regime); on real TPU hardware set it to the part's
+    #: peak-FLOPs/peak-bandwidth ratio (~240 for v4) before reading
+    #: verdicts off ``bench.py --roofline``.
+    obs_roofline_ridge: float = 10.0
 
     # --- distributed runtime (citizensassemblies_tpu/dist) ---------------------
     #: graftpod mesh gate. ``True``: shardable stages (the MC estimator's

@@ -27,8 +27,9 @@ worker threads.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import OrderedDict
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 #: guards the module-wide eviction counters (service worker threads evict
 #: concurrently); LRU instances reuse it — evictions are rare enough that a
@@ -55,6 +56,18 @@ def memo_evictions_by_owner() -> Dict[str, int]:
         return dict(_EVICTIONS_BY_OWNER)
 
 
+#: weak registry of every live LRU — the graftscope memory ledger walks it
+#: to attribute resident cache bytes per owner (``obs/memory.py``). Weak so
+#: a dropped cache (a torn-down tenant session) leaves no ghost entry.
+_INSTANCES: "weakref.WeakSet[LRU]" = weakref.WeakSet()
+
+
+def live_caches() -> List["LRU"]:
+    """Every LRU currently alive in the process (a snapshot copy)."""
+    with _EVICTION_LOCK:
+        return list(_INSTANCES)
+
+
 class LRU:
     """A small ordered cache with least-recently-used eviction.
 
@@ -71,6 +84,8 @@ class LRU:
         self._d: "OrderedDict[Any, Any]" = OrderedDict()
         self._owners: Dict[Any, str] = {}
         self.evictions = 0
+        with _EVICTION_LOCK:
+            _INSTANCES.add(self)
 
     def get(self, key, default: Optional[Any] = None):
         try:

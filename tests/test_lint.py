@@ -679,3 +679,118 @@ def test_r10_memoized_and_factory_closures_allowed(tmp_path):
         "    return jax.shard_map(core, mesh=mesh, in_specs=P(), out_specs=P())\n"
     )})
     assert "R10" not in _rules(report), render_report(report)
+
+
+# --- R11: metric hygiene -----------------------------------------------------
+
+_CATALOG = (
+    "METRIC_SERIES = {\n"
+    "    'good_total': 'a registered counter',\n"
+    "    'depth': 'a registered gauge',\n"
+    "    'phase_x': 'a registered timer',\n"
+    "}\n"
+    "METRIC_PREFIXES = {'fault_'}\n"
+)
+
+
+def test_r11_catalogued_names_and_dynamic_forms_clean(tmp_path):
+    report = _lint(tmp_path, {
+        "obs/catalog.py": _CATALOG,
+        "mod.py": (
+            "import itertools\n"
+            "\n"
+            "def run(log, reg, site, deep):\n"
+            "    log.count('good_total')\n"
+            "    reg.gauge('depth')\n"
+            "    with log.timer('phase_x'):\n"
+            "        pass\n"
+            "    log.count(f'fault_{site}')  # registered prefix family\n"
+            "    log.gauge('depth' if deep else 'phase_x')  # IfExp, both good\n"
+            "    next(itertools.count(1))  # generic count, not an emission\n"
+            "    return 'abc'.count('a')\n"
+        ),
+    })
+    assert "R11" not in _rules(report), render_report(report)
+
+
+def test_r11_unregistered_literal_flagged(tmp_path):
+    report = _lint(tmp_path, {
+        "obs/catalog.py": _CATALOG,
+        "mod.py": (
+            "def run(log):\n"
+            "    log.count('typo_total')\n"
+        ),
+    })
+    viols = [v for v in report.violations if v.rule == "R11"]
+    assert len(viols) == 1, render_report(report)
+    assert "typo_total" in viols[0].message
+
+
+def test_r11_computed_name_and_bad_prefix_flagged(tmp_path):
+    report = _lint(tmp_path, {
+        "obs/catalog.py": _CATALOG,
+        "mod.py": (
+            "def run(log, name, site):\n"
+            "    log.count(name)  # computed: the catalogue cannot see it\n"
+            "    log.count(f'rogue_{site}')  # unregistered prefix family\n"
+        ),
+    })
+    viols = [v for v in report.violations if v.rule == "R11"]
+    assert len(viols) == 2, render_report(report)
+    assert any("computed" in v.message for v in viols)
+    assert any("rogue_" in v.message for v in viols)
+
+
+def test_r11_ifexp_flags_only_the_unregistered_arm(tmp_path):
+    report = _lint(tmp_path, {
+        "obs/catalog.py": _CATALOG,
+        "mod.py": (
+            "def run(log, deep):\n"
+            "    log.gauge('depth' if deep else 'rogue_gauge')\n"
+        ),
+    })
+    viols = [v for v in report.violations if v.rule == "R11"]
+    assert len(viols) == 1, render_report(report)
+    assert "rogue_gauge" in viols[0].message
+
+
+def test_r11_count_claimed_only_on_log_like_receivers(tmp_path):
+    report = _lint(tmp_path, {
+        "obs/catalog.py": _CATALOG,
+        "mod.py": (
+            "def run(log, audit_log, tenant_metrics, mlir, text):\n"
+            "    audit_log.count('rogue_a')\n"
+            "    tenant_metrics.count('rogue_b')\n"
+            "    mlir.count('rogue_c')  # non-log receiver: not an emission\n"
+            "    text.count('rogue_d')\n"
+        ),
+    })
+    viols = [v for v in report.violations if v.rule == "R11"]
+    assert len(viols) == 2, render_report(report)
+    assert {m for v in viols for m in ("rogue_a", "rogue_b") if m in v.message} == {
+        "rogue_a", "rogue_b"
+    }
+
+
+def test_r11_tests_and_plumbing_exempt(tmp_path):
+    report = _lint(tmp_path, {
+        "obs/catalog.py": _CATALOG,
+        "tests/test_mod.py": (
+            "def test_run(log):\n"
+            "    log.count('adhoc_fixture_name')\n"
+        ),
+        "utils/logging.py": (
+            "def count(self, name):\n"
+            "    self.metrics.counter(name).inc()\n"
+        ),
+    })
+    assert "R11" not in _rules(report), render_report(report)
+
+
+def test_r11_inert_without_catalogue_in_scope(tmp_path):
+    # no obs/catalog.py under the lint scope: nothing to judge against
+    report = _lint(tmp_path, {"mod.py": (
+        "def run(log):\n"
+        "    log.count('whatever')\n"
+    )})
+    assert "R11" not in _rules(report), render_report(report)

@@ -33,20 +33,28 @@ import time
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 from citizensassemblies_tpu.core.generator import random_instance
 from citizensassemblies_tpu.core.instance import featurize
 from citizensassemblies_tpu.models.leximin import find_distribution_leximin
 from citizensassemblies_tpu.obs import (
     TRACE_SCHEMA_VERSION,
+    MemoryLedger,
     MetricsRegistry,
     Tracer,
+    ambient_ledger,
     dispatch_span,
     export_chrome_trace,
+    leak_verdict,
+    owner_attribution,
+    roofline_join,
     span_coverage,
+    use_ledger,
     use_tracer,
     validate_chrome_trace,
 )
+from citizensassemblies_tpu.obs.slo import SloEngine, parse_slo_spec
 from citizensassemblies_tpu.obs.trend import collect_series, trend_gate
 from citizensassemblies_tpu.service.context import RequestContext, use_context
 from citizensassemblies_tpu.utils.config import default_config
@@ -197,6 +205,18 @@ def test_obs_off_bitwise_identity_tiny_leximin():
     assert np.array_equal(d_off.allocation, d_on.allocation)
     assert np.array_equal(d_off.fixed_probabilities, d_on.fixed_probabilities)
     assert tr.span_count > 0  # the traced twin actually traced
+    # graftscope contract: obs_memory hard-off wins over an installed
+    # ambient ledger — the run records NOTHING and stays bit-identical
+    led = MemoryLedger(name="off_probe", attribute_owners=False)
+    with use_ledger(led):
+        d_mem_off = find_distribution_leximin(
+            dense, space, cfg=cfg_off.replace(obs_memory=False)
+        )
+    assert led.records == []
+    assert np.array_equal(d_off.allocation, d_mem_off.allocation)
+    assert np.array_equal(
+        d_off.fixed_probabilities, d_mem_off.fixed_probabilities
+    )
 
 
 # --- metrics registry --------------------------------------------------------
@@ -329,6 +349,378 @@ def test_trend_recovers_rows_from_truncated_tails():
     assert any(
         any(rnd in (3, 4, 5) for rnd, _v in pts) for pts in series.values()
     )
+
+
+# --- graftscope: memory ledger -----------------------------------------------
+
+
+def test_memory_ledger_snapshots_series_and_stamp():
+    import jax.numpy as jnp
+
+    led = MemoryLedger(name="unit", attribute_owners=False)
+    base = led.snapshot("baseline")
+    assert base["live_bytes"] >= 0 and base["live_arrays"] >= 0
+    held = []  # keep the arrays live so the trajectory cannot shrink
+    for i in range(3):
+        held.append(jnp.zeros(4096 * (i + 1), dtype=jnp.float32))
+        led.snapshot("warm_rep")
+    led.snapshot("teardown")
+    series = led.series("warm_rep")
+    assert len(series) == 3  # phase filter excludes baseline/teardown
+    assert len(led.series()) == 5
+    assert series[-1] >= series[0]  # we only ever added arrays
+    assert led.high_watermark_bytes >= max(series)
+    stamp = led.stamp()
+    assert stamp["schema_version"] == 1
+    assert stamp["snapshots"] == 5
+    assert stamp["ledger"] == "unit"
+    assert stamp["live_bytes_last"] == led.records[-1]["live_bytes"]
+    assert "owners" not in stamp  # attribution disabled for this ledger
+    del held
+
+
+def test_leak_verdict_requires_strict_monotonic_growth():
+    assert leak_verdict([100, 200, 300]) is True
+    assert leak_verdict([100, 200, 300, 400]) is True
+    # one flat or descending step anywhere clears the verdict
+    assert leak_verdict([100, 200, 200]) is False
+    assert leak_verdict([100, 300, 200]) is False
+    # fewer than 3 warm reps never convicts
+    assert leak_verdict([]) is False
+    assert leak_verdict([100, 200]) is False
+
+
+def test_dispatch_span_snapshots_ambient_ledger_and_hard_off_is_inert():
+    cfg = default_config()
+    led = MemoryLedger(name="span_probe", attribute_owners=False)
+    with use_ledger(led):
+        assert ambient_ledger() is led
+        with dispatch_span("core.mem", cfg=cfg) as ds:
+            ds.out = None
+        assert [r["phase"] for r in led.records] == ["core.mem"]
+        # obs_memory hard-off: same ambient ledger, no snapshot
+        with dispatch_span("core.off", cfg=cfg.replace(obs_memory=False)) as ds:
+            ds.out = None
+        assert len(led.records) == 1
+        # the snapshot also fires on the traced path, at span exit
+        tr = Tracer(name="t")
+        with use_tracer(tr):
+            with dispatch_span("core.traced", cfg=cfg) as ds:
+                ds.out = None
+        assert [r["phase"] for r in led.records] == ["core.mem", "core.traced"]
+    assert ambient_ledger() is None
+
+
+def test_owner_attribution_walks_the_lru_registry():
+    from citizensassemblies_tpu.utils.memo import LRU
+
+    cache = LRU(4, name="unit_cache")
+    cache.put("a", np.zeros(128, dtype=np.float64), owner="tenant:alpha")
+    cache.put("b", np.zeros(64, dtype=np.float64))
+    owners = owner_attribution()
+    # owned entries attribute to the owner, the rest to the cache's name
+    assert owners.get("tenant:alpha", 0) >= 128 * 8
+    assert owners.get("unit_cache", 0) >= 64 * 8
+    # the ledger stamp surfaces the same attribution
+    stamp = MemoryLedger(name="o").stamp()
+    assert stamp["owners"].get("tenant:alpha", 0) >= 128 * 8
+    del cache  # WeakSet registry: the cache unregisters with its referent
+
+
+# --- graftscope: roofline attribution ----------------------------------------
+
+
+def _tiny_budget(tmp_path):
+    path = tmp_path / "budget.json"
+    path.write_text(json.dumps({
+        "_meta": {"generated_by": "test", "jax": "0", "tolerance": 0.25},
+        "cores": {
+            "lp.core": {"bytes": 1.0e6, "flops": 4.0e6, "prims": {}},
+            "never.fired": {"bytes": 1.0, "flops": 1.0, "prims": {}},
+        },
+    }))
+    return path
+
+
+def test_roofline_join_rates_verdicts_and_trend_detail(tmp_path):
+    budget = _tiny_budget(tmp_path)
+    tr = Tracer(name="synthetic")
+    for _ in range(2):
+        with tr.span("lp.core", kind="dispatch", sampled=True):
+            time.sleep(0.01)
+    report = roofline_join([tr], budget_path=budget, ridge=10.0)
+    assert report.ok and report.misses == []
+    assert report.unexecuted == ["never.fired"]
+    (row,) = report.rows
+    assert row.core == "lp.core" and row.calls == 2 and row.sampled
+    assert row.finite and row.seconds >= 0.02
+    # budget flops over measured seconds: 2 calls × 4 MFLOP / seconds
+    assert row.achieved_gflops_s == pytest.approx(
+        2 * 4.0e6 / row.seconds / 1e9, rel=1e-3
+    )
+    assert row.intensity_flops_per_byte == 4.0
+    assert row.bound == "bytes-bound"  # 4 FLOP/B under the ridge of 10
+    low_ridge = roofline_join([tr], budget_path=budget, ridge=1.0)
+    assert low_ridge.rows[0].bound == "compute-bound"
+    doc = report.as_json()
+    assert doc["roofline_ok"] is True and doc["rows"]["lp.core"]["calls"] == 2
+    # trend rows: dots become underscores so _ROW_RE can recover them
+    detail = report.trend_detail()
+    assert set(detail) == {"roofline_lp_core"}
+    assert detail["roofline_lp_core"]["seconds"] == row.seconds
+
+
+def test_roofline_join_miss_and_unsampled_fail(tmp_path):
+    budget = _tiny_budget(tmp_path)
+    tr = Tracer(name="synthetic")
+    with tr.span("lp.core", kind="dispatch", sampled=True):
+        time.sleep(0.002)
+    # a dispatch span the static layer cannot see is a JOIN MISS
+    with tr.span("rogue.core", kind="dispatch", sampled=True):
+        pass
+    # non-dispatch spans never join
+    with tr.span("host_phase"):
+        pass
+    report = roofline_join([tr], budget_path=budget, ridge=10.0)
+    assert report.misses == ["rogue.core"]
+    assert not report.ok
+    assert {r.core for r in report.rows} == {"lp.core"}
+    # one unsampled call poisons the core's sampled flag (AND-fold)
+    tr2 = Tracer(name="synthetic2")
+    with tr2.span("lp.core", kind="dispatch", sampled=True):
+        time.sleep(0.002)
+    with tr2.span("lp.core", kind="dispatch"):
+        time.sleep(0.002)
+    report2 = roofline_join([tr2], budget_path=budget, ridge=10.0)
+    assert report2.rows[0].calls == 2
+    assert report2.rows[0].sampled is False
+
+
+# --- graftscope: SLO engine --------------------------------------------------
+
+
+def test_parse_slo_spec_grammar_and_errors():
+    spec = parse_slo_spec(
+        "latency_p99:20s, error_rate:0.01, civic/latency_p99:150ms"
+    )
+    assert spec[None] == {"latency_p99": 20.0, "error_rate": 0.01}
+    assert spec["civic"] == {"latency_p99": 0.15}
+    assert parse_slo_spec("") == {}
+    assert parse_slo_spec("latency_p50:2.5")[None] == {"latency_p50": 2.5}
+    with pytest.raises(ValueError):
+        parse_slo_spec("latency_p99")  # no target
+    with pytest.raises(ValueError):
+        parse_slo_spec("throughput:5")  # unknown objective
+
+
+def test_slo_engine_burn_rates_breach_transitions_and_recovery():
+    now = [0.0]
+    eng = SloEngine("latency_p99:1s,error_rate:0.25", clock=lambda: now[0])
+    for _ in range(8):
+        eng.record("civic", 0.01, ok=True)
+    report = eng.evaluate()
+    civic = report["tenants"]["civic"]
+    assert report["slo_ok"] is True and report["events"] == 8
+    assert civic["latency_p99"]["observed"] == 0.01
+    assert civic["error_rate"]["burn_rates"]["60s"] == 0.0
+    assert report["spec"]["*"]["error_rate"] == 0.25
+    assert eng.new_breaches() == []
+    # half the fleet fails: error_rate 0.5 > 0.25, burn 2x on every window
+    for _ in range(8):
+        eng.record("civic", 0.01, ok=False)
+    report = eng.evaluate()
+    civic = report["tenants"]["civic"]
+    assert civic["error_rate"]["observed"] == 0.5
+    assert civic["error_rate"]["ok"] is False
+    assert civic["error_rate"]["burn_rates"]["60s"] == 2.0
+    fresh = eng.new_breaches()
+    assert [b["objective"] for b in fresh] == ["error_rate"]
+    assert eng.new_breaches() == []  # steady-state breaching: no re-emission
+    # recovery: the bad events age out past the slowest window…
+    now[0] += 3601.0
+    for _ in range(4):
+        eng.record("civic", 0.01, ok=True)
+    assert eng.evaluate()["slo_ok"] is True
+    assert eng.new_breaches() == []  # recovery itself is not a breach
+    # …and a NEW breach transition re-emits (the transition re-armed)
+    for _ in range(4):
+        eng.record("civic", 0.01, ok=False)
+    assert [b["objective"] for b in eng.new_breaches()] == ["error_rate"]
+
+
+def test_slo_tenant_override_applies_only_to_that_tenant():
+    now = [0.0]
+    eng = SloEngine(
+        "latency_p99:10s,civic/latency_p99:100ms", clock=lambda: now[0]
+    )
+    for _ in range(5):
+        eng.record("civic", 0.5, ok=True)
+        eng.record("other", 0.5, ok=True)
+    report = eng.evaluate()
+    assert report["tenants"]["civic"]["latency_p99"]["target"] == 0.1
+    assert report["tenants"]["civic"]["latency_p99"]["ok"] is False
+    assert report["tenants"]["other"]["latency_p99"]["ok"] is True
+    assert [(b["tenant"], b["objective"]) for b in report["breaches"]] == [
+        ("civic", "latency_p99")
+    ]
+
+
+# --- graftscope: trace CLI ---------------------------------------------------
+
+
+def _write_trace(tmp_path, name: str, scale: float = 1.0) -> str:
+    """A two-lane synthetic Chrome trace in the export's schema: pid-1
+    request -> solve -> pdhg (the critical chain) plus overlapping
+    batch_window spans on both lanes (a fused batcher window)."""
+    ev = [
+        {"ph": "M", "name": "process_name", "pid": 1, "args": {"name": "req_A"}},
+        {"ph": "M", "name": "process_name", "pid": 2, "args": {"name": "req_B"}},
+    ]
+
+    def span(pid, sid, parent, nm, ts, dur):
+        ev.append({
+            "ph": "X", "pid": pid, "tid": 1, "name": nm, "ts": ts, "dur": dur,
+            "args": {"span_id": sid, "parent_id": parent},
+        })
+
+    span(1, 1, None, "request", 0.0, 1000.0 * scale)
+    span(1, 2, 1, "solve", 100.0, 800.0 * scale)
+    span(1, 3, 2, "pdhg", 200.0, 500.0 * scale)
+    span(1, 4, 1, "batch_window", 0.0, 90.0)
+    span(2, 5, None, "request", 10.0, 400.0)
+    span(2, 6, 5, "batch_window", 20.0, 80.0)
+    path = tmp_path / name
+    path.write_text(json.dumps({"traceEvents": ev}))
+    return str(path)
+
+
+def test_trace_cli_critical_path_self_time_fusion_and_diff(tmp_path, capsys):
+    from citizensassemblies_tpu.obs.__main__ import analyze, diff, main
+
+    a = _write_trace(tmp_path, "a.json", scale=1.0)
+    b = _write_trace(tmp_path, "b.json", scale=2.0)
+    report = analyze(a)
+    assert report["spans"] == 6 and report["lanes"] == 2
+    # heaviest descent: the pid-1 request, then its largest child each hop
+    assert [h["name"] for h in report["critical_path"]] == [
+        "request", "solve", "pdhg",
+    ]
+    assert report["critical_path"][1]["of_parent"] == pytest.approx(0.8)
+    st = report["self_times"]
+    # exclusive time: duration minus the union of child intervals, across
+    # BOTH lanes for the shared "request" name (110 µs + 320 µs)
+    assert st["request"]["self_ms"] == pytest.approx(0.43)
+    assert st["solve"]["self_ms"] == pytest.approx(0.3)
+    assert st["pdhg"]["self_ms"] == pytest.approx(0.5)
+    # the two lanes' batch_window spans overlap: one FUSED cluster
+    (cluster,) = report["fusion_timeline"]
+    assert cluster["fused"] is True and cluster["spans"] == 2
+    assert cluster["requests"] == ["req_A", "req_B"]
+    # diff: the scaled twin doubles the pdhg phase
+    d = diff(a, b)
+    assert d["phases"]["pdhg"]["ratio"] == pytest.approx(2.0)
+    assert d["phases"]["pdhg"]["delta_ms"] == pytest.approx(0.5)
+    # CLI entry point round-trips both modes through --json
+    assert main([a, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["spans"] == 6
+    assert main([a, "--diff", b, "--json"]) == 0
+    assert "phases" in json.loads(capsys.readouterr().out)
+    # human-readable mode renders without error
+    assert main([a]) == 0
+    assert "critical path" in capsys.readouterr().out
+
+
+# --- graftscope: trend loader edge cases -------------------------------------
+
+
+def test_trend_loader_edge_cases_and_roofline_family(tmp_path):
+    # empty artifacts dir: no rounds, the gate trivially passes
+    series, rounds = collect_series(tmp_path)
+    assert series == {} and rounds == []
+    assert trend_gate(tmp_path).ok
+    # a single-round family is recorded but never gates
+    (tmp_path / "BENCH_kernels_r01.json").write_text(
+        json.dumps({"detail": {"kern_row": {"seconds": 5.0}}})
+    )
+    report = trend_gate(tmp_path)
+    assert report.ok
+    assert [(r.name, r.status) for r in report.rows] == [
+        ("kern_row", "insufficient")
+    ]
+    # malformed artifacts are skipped, never fatal: broken JSON, and rows
+    # whose names/values the recovery regex refuses
+    (tmp_path / "BENCH_kernels_r02.json").write_text("{ not json")
+    (tmp_path / "BENCH_kernels_r03.json").write_text(
+        json.dumps({"detail": {"bad row name!": {"seconds": "nan"}}})
+    )
+    series, rounds = collect_series(tmp_path)
+    assert rounds == [1]
+    # duplicate round numbers across families merge into one round
+    (tmp_path / "ROOFLINE_r04.json").write_text(json.dumps({
+        "detail": {
+            "roofline_lp_core": {"seconds": 3.0},
+            "kern_row": {"seconds": 5.5},
+        }
+    }))
+    (tmp_path / "BENCH_kernels_r04.json").write_text(
+        json.dumps({"detail": {"kern_row2": {"seconds": 2.0}}})
+    )
+    series, rounds = collect_series(tmp_path)
+    assert rounds == [1, 4]
+    assert series["kern_row"] == [(1, 5.0), (4, 5.5)]
+    assert series["kern_row2"] == [(4, 2.0)]
+    # the ROOFLINE_r* family is a first-class gated series: a >tol
+    # regression in a later round fails the gate
+    (tmp_path / "ROOFLINE_r05.json").write_text(
+        json.dumps({"detail": {"roofline_lp_core": {"seconds": 6.5}}})
+    )
+    report = trend_gate(tmp_path)
+    assert [r.name for r in report.failures] == ["roofline_lp_core"]
+
+
+# --- graftscope: service SLO stream ------------------------------------------
+
+
+def test_service_streams_slo_breach_events_on_queue_stall():
+    """End-to-end breach drill: a certain queue_stall fault pushes every
+    sojourn over a 50 ms p99 target, so the engine must breach and the
+    service must stream the TRANSITION into open channels before the
+    terminal event."""
+    from citizensassemblies_tpu.service import SelectionRequest, SelectionService
+
+    cfg = default_config().replace(
+        obs_slo_spec="latency_p99:50ms,error_rate:0.9",
+        fault_sites="queue_stall:1.0",
+        fault_seed=11,
+        obs_metrics_interval_s=0.0,
+    )
+    svc = SelectionService(cfg)
+    try:
+        insts = [
+            random_instance(n=40, k=5, n_categories=2, seed=s) for s in range(2)
+        ]
+        chans = [
+            svc.submit(SelectionRequest(instance=i, tenant="civic"))
+            for i in insts
+        ]
+        results = [ch.result(timeout=300) for ch in chans]
+        assert len(results) == 2
+        breaches = [
+            payload
+            for ch in chans
+            for kind, payload in ch.events(timeout=1)
+            if kind == "slo"
+        ]
+        assert breaches, "no ('slo', …) breach event reached an open channel"
+        assert breaches[0]["tenant"] == "civic"
+        assert breaches[0]["objective"] == "latency_p99"
+        assert breaches[0]["observed"] > breaches[0]["target"]
+        # the engine's report and the fleet counter agree with the stream
+        report = svc.slo.evaluate()
+        assert report["slo_ok"] is False and report["events"] == 2
+        assert "graftserve_slo_breach_total" in svc.metrics_text()
+    finally:
+        svc.shutdown()
 
 
 # --- service metrics stream --------------------------------------------------
