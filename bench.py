@@ -1360,6 +1360,222 @@ def kernels_bench(smoke_mode: bool = False) -> int:
     return 1 if failures else 0
 
 
+def churn_bench(smoke_mode: bool = False) -> int:
+    """``--churn``: graftdelta incremental re-certification under registry
+    churn — a seeded edit trail against one nationwide-scale registry, the
+    delta arm running EVERY edit while the from-scratch arm is sampled per
+    edit class (a full from-scratch trail would cost hours and prove
+    nothing extra). Emits ``BENCH_churn_rNN.json`` in the BENCH_detail row
+    schema so ``obs/trend.py`` folds the churn family into the regression
+    gate.
+
+    Evidence tiers (see README "Incremental re-certification"):
+
+    * **bench tier** (this function) — type-space certificate only: the
+      delta arm's answer is compared against an actual from-scratch
+      re-certification on the sampled edits (type-value L∞ ≤ 1e-3, the
+      same bound the service audits per agent), and every edit's own
+      ``eps_bound`` certificate must stay inside the contract;
+    * **service tier** (tests/test_delta.py) — the full Distribution
+      round-trip through ``SelectionRequest(revise=…)``.
+
+    Hard assertions (non-zero exit): delta median beats the from-scratch
+    median by ≥ 5× (smoke: ≥ 2× — small pools shrink the cache-hit
+    envelope), the contract holds on every edit, and the sensitivity cache
+    certificate fires at least once.
+    """
+    import numpy as np
+
+    from citizensassemblies_tpu.data.registry import (
+        apply_edit,
+        churn_trail,
+        nationwide_registry,
+    )
+    from citizensassemblies_tpu.solvers import delta as graftdelta
+    from citizensassemblies_tpu.utils.config import default_config
+
+    t_start = time.time()
+    if smoke_mode:
+        n, k, n_edits, scratch_reps, speedup_floor = 30_000, 173, 40, 2, 2.0
+    else:
+        n, k, n_edits, scratch_reps, speedup_floor = 100_000, 316, 1000, 6, 5.0
+    cfg = default_config()
+    failures = []
+    detail = {}
+
+    reg = nationwide_registry(
+        n=n,
+        k=k,
+        seed=16,
+        categories=(("region", [f"r{i}" for i in range(8)]),),
+        quota_slack=0.003,
+    )
+    # small per-edit footprints keep the drift bound inside the certificate
+    # margin at this pool size — the regime the cache certificate targets.
+    # The class mix leans toward agent churn (a registry's daily reality is
+    # joins and drops; quota amendments are rarer), new types are capped,
+    # and the quota walk carries a slight TIGHTEN bias: every relaxation
+    # permanently widens the composition hull, so a non-reverting walk
+    # grows the instance itself until it leaves the enumerable tier (a
+    # balanced 0.12/0.12 walk blew past enum_cap around edit 700 of a
+    # 1000-edit trail). The bias is self-limiting — a tighten whose band
+    # edge already sits at the witness count falls through to a relax —
+    # so bands hover near their seeded width and the medians describe ONE
+    # near-stationary instance, not a drifting family
+    edits = churn_trail(
+        reg,
+        n_edits,
+        seed=16,
+        max_edit_agents=8,
+        max_new_types=2,
+        weights={
+            "agents_add": 0.36,
+            "agents_drop": 0.34,
+            "quota_relax": 0.10,
+            "quota_tighten": 0.14,
+            "new_type": 0.06,
+        },
+    )
+
+    def scratch(r):
+        t0 = time.time()
+        st = graftdelta.certify_base(r, cfg=cfg)
+        return time.time() - t0, st
+
+    def type_linf(state_a, state_b):
+        # match types across the two states by feature key; L∞ over types
+        # with live pools equals the per-agent L∞ the service contract uses
+        ia = {
+            tuple(int(v) for v in row): t
+            for t, row in enumerate(state_a.system.type_feature)
+        }
+        worst = 0.0
+        for t_b, row in enumerate(state_b.system.type_feature):
+            if state_b.system.msize[t_b] == 0:
+                continue
+            t_a = ia.get(tuple(int(v) for v in row))
+            if t_a is None:
+                return float("inf")
+            worst = max(
+                worst,
+                abs(
+                    float(state_a.type_values[t_a])
+                    - float(state_b.type_values[t_b])
+                ),
+            )
+        return worst
+
+    base_s, state = scratch(reg)
+    if state is None:
+        print(json.dumps({"churn_ok": False, "error": "base solve failed"}))
+        return 1
+    detail["churn_base_certify"] = {"seconds": round(base_s, 3)}
+
+    delta_times = []
+    per_class: dict = {}
+    scratch_times: dict = {}
+    modes = {"cache_hit": 0, "resume": 0, "full_ladder": 0, "fallback": 0}
+    worst_linf = 0.0
+    worst_eps = 0.0
+    cur = reg
+    for i, edit in enumerate(edits):
+        nxt = apply_edit(cur, edit)
+        t0 = time.time()
+        out = graftdelta.recertify(state, edit, cur, cfg=cfg)
+        if out is not None:
+            dt = time.time() - t0
+            state = out.state
+            modes[out.cert["mode"]] += 1
+            worst_eps = max(worst_eps, float(out.cert["eps_bound"]))
+        else:
+            # outside the delta envelope: the honest delta-arm cost of this
+            # edit is a fresh base certification
+            s_fb, state = scratch(nxt)
+            dt = time.time() - t0
+            if state is None:
+                failures.append(f"edit {i} ({edit.kind}): both arms failed")
+                break
+            modes["fallback"] += 1
+        delta_times.append(dt)
+        per_class.setdefault(edit.kind, []).append(dt)
+        if len(scratch_times.setdefault(edit.kind, [])) < scratch_reps:
+            s_t, s_state = scratch(nxt)
+            if s_state is None:
+                failures.append(f"edit {i} ({edit.kind}): from-scratch failed")
+            else:
+                scratch_times[edit.kind].append(s_t)
+                linf = type_linf(state, s_state)
+                worst_linf = max(worst_linf, linf)
+                if linf > 1e-3:
+                    failures.append(
+                        f"edit {i} ({edit.kind}): delta vs from-scratch "
+                        f"type-value L∞ {linf:.2e} > 1e-3"
+                    )
+        cur = nxt
+
+    def med(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2] if xs else float("nan")
+
+    delta_median = med(delta_times)
+    scratch_all = [t for ts in scratch_times.values() for t in ts]
+    scratch_median = med(scratch_all)
+    speedup = scratch_median / max(delta_median, 1e-9)
+    detail["churn_delta_median"] = {
+        "seconds": round(delta_median, 4),
+        "speedup": round(speedup, 1),
+        "edits": len(delta_times),
+    }
+    detail["churn_scratch_median"] = {
+        "seconds": round(scratch_median, 4),
+        "samples": len(scratch_all),
+    }
+    for kind, ts in sorted(per_class.items()):
+        detail[f"churn_delta_{kind}"] = {
+            "seconds": round(med(ts), 4),
+            "edits": len(ts),
+            "scratch_median_s": round(med(scratch_times.get(kind, [])), 4),
+        }
+    if speedup < speedup_floor:
+        failures.append(
+            f"delta median {delta_median:.3f}s vs from-scratch "
+            f"{scratch_median:.3f}s: speedup {speedup:.1f}× < {speedup_floor}×"
+        )
+    if worst_eps > 1e-3:
+        failures.append(
+            f"certified eps_bound {worst_eps:.2e} exceeded the 1e-3 contract"
+        )
+    if modes["cache_hit"] < 1:
+        failures.append("the sensitivity cache certificate never fired")
+
+    doc = {
+        "schema_version": 1,
+        "churn_ok": not failures,
+        "seconds": round(time.time() - t_start, 1),
+        "backend": __import__("jax").default_backend(),
+        "smoke": bool(smoke_mode),
+        "n": n,
+        "edits": len(delta_times),
+        "modes": modes,
+        "speedup": round(speedup, 1),
+        "worst_linf_vs_scratch": worst_linf,
+        "worst_eps_bound": worst_eps,
+        "detail": detail,
+        "failures": failures,
+    }
+    print(json.dumps(doc))
+    out_path = os.environ.get(
+        "BENCH_CHURN_PATH", os.path.join(_artifacts_dir(), "BENCH_churn_r16.json")
+    )
+    try:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+    except OSError:
+        pass
+    return 1 if failures else 0
+
+
 def roofline_bench(smoke_mode: bool = False) -> int:
     """``--roofline``: graftscope runtime roofline attribution over the
     full IR-core registry.
@@ -2685,6 +2901,8 @@ if __name__ == "__main__":
         raise SystemExit(dist_bench(smoke_mode="--smoke" in sys.argv))
     if "--kernels" in sys.argv:
         raise SystemExit(kernels_bench(smoke_mode="--smoke" in sys.argv))
+    if "--churn" in sys.argv:
+        raise SystemExit(churn_bench(smoke_mode="--smoke" in sys.argv))
     if "--roofline" in sys.argv:
         raise SystemExit(roofline_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:

@@ -32,7 +32,7 @@ instance family at scale:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -245,3 +245,276 @@ def nationwide_registry(
         witness=witness,
         seed=int(seed),
     )
+
+
+# --- registry churn: the graftdelta edit model --------------------------------
+#
+# A real registry is never static: volunteers join and drop daily, quotas get
+# amended mid-recruitment, and occasionally a whole new demographic class
+# appears. ``RegistryEdit`` is the atomic unit of that churn — small enough
+# that the delta solver (``solvers/delta.py``) can re-certify in ~O(edit) —
+# and ``churn_trail`` generates seeded sequences of them that provably keep
+# every intermediate registry witness-feasible (``check_witness``).
+
+#: the five edit classes the delta solver distinguishes (each maps onto the
+#: type space differently — see ``solvers/delta.py``).
+EDIT_KINDS: Tuple[str, ...] = (
+    "agents_add",  # volunteers join existing types (pool weights shift)
+    "agents_drop",  # volunteers leave (never witness members)
+    "quota_relax",  # a cell's band widens (new compositions become feasible)
+    "quota_tighten",  # a cell's band narrows toward the witness count
+    "new_type",  # a new feature value (household class) appears in a category
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RegistryEdit:
+    """One atomic registry edit (see :data:`EDIT_KINDS`).
+
+    ``rows`` carries the appended agents' feature-index rows for
+    ``agents_add``/``new_type`` (for ``new_type`` the edited category's
+    index is the NEW feature slot, i.e. ``len(features[category])`` at
+    application time); ``agents`` the dropped agent ids for
+    ``agents_drop``; ``cell``/``dlo``/``dhi`` the flat quota cell and band
+    deltas for the quota edits; ``category``/``feature`` the new feature's
+    placement for ``new_type`` (its quota band is ``[0, dhi]`` — the lower
+    bound MUST be 0 so the witness panel, which contains none of the new
+    type, stays feasible).
+    """
+
+    kind: str
+    rows: Optional[np.ndarray] = None  # int32 [e, C]
+    agents: Optional[np.ndarray] = None  # int64 [e]
+    cell: int = -1
+    dlo: int = 0
+    dhi: int = 0
+    category: int = -1
+    feature: str = ""
+
+    @property
+    def magnitude(self) -> int:
+        """Edit size in its natural unit: agents touched, or quota seats
+        moved — the quantity ``Config.delta_max_edit_frac`` gates on."""
+        if self.kind in ("agents_add", "new_type"):
+            return int(self.rows.shape[0]) if self.rows is not None else 0
+        if self.kind == "agents_drop":
+            return int(len(self.agents)) if self.agents is not None else 0
+        return abs(int(self.dlo)) + abs(int(self.dhi))
+
+    def describe(self) -> str:
+        if self.kind in ("agents_add", "agents_drop"):
+            return f"{self.kind}({self.magnitude} agents)"
+        if self.kind == "new_type":
+            return (
+                f"new_type(cat {self.category} += {self.feature!r}, "
+                f"{self.magnitude} agents, band [0, {self.dhi}])"
+            )
+        return f"{self.kind}(cell {self.cell}, dlo {self.dlo:+d}, dhi {self.dhi:+d})"
+
+
+def apply_edit(reg: Registry, edit: RegistryEdit) -> Registry:
+    """Apply one :class:`RegistryEdit`, returning a NEW registry (the input
+    is never mutated — the delta solver diffs the two).
+
+    Validates structural sanity (index ranges, band ordering, witness
+    survival on drops) and raises ``ValueError`` on violation; quota
+    FEASIBILITY preservation is the trail generator's contract, checkable
+    afterwards via :meth:`Registry.check_witness`.
+    """
+    C = reg.n_categories
+    feats = tuple(tuple(f) for f in reg.features)
+    assignments = reg.assignments
+    household_id = reg.household_id
+    witness = reg.witness
+    qmin, qmax = reg.qmin.copy(), reg.qmax.copy()
+
+    if edit.kind in ("agents_add", "new_type"):
+        rows = np.asarray(edit.rows, dtype=np.int32)
+        if rows.ndim != 2 or rows.shape[1] != C or rows.shape[0] == 0:
+            raise ValueError(f"{edit.kind}: rows must be int [e>0, {C}]")
+        if edit.kind == "new_type":
+            c = int(edit.category)
+            if not (0 <= c < C):
+                raise ValueError(f"new_type: category {c} out of range")
+            name = edit.feature or f"{reg.categories[c]}_new"
+            if name in feats[c]:
+                raise ValueError(f"new_type: feature {name!r} already exists")
+            if edit.dhi <= 0:
+                raise ValueError("new_type: dhi must be > 0 (the new cell's band)")
+            new_slot = len(feats[c])
+            if not np.all(rows[:, c] == new_slot):
+                raise ValueError(
+                    f"new_type: rows must reference the new slot {new_slot} "
+                    f"in category {c}"
+                )
+            feats = tuple(
+                f + (name,) if ci == c else f for ci, f in enumerate(feats)
+            )
+            # the flat quota layout shifts: insert the new cell (band
+            # [0, dhi]) at the end of category c's block
+            at = int(reg.cell_offsets[c]) + new_slot
+            qmin = np.insert(qmin, at, 0).astype(np.int32)
+            qmax = np.insert(qmax, at, min(int(edit.dhi), reg.k)).astype(np.int32)
+        sizes = np.asarray([len(f) for f in feats])
+        if np.any(rows < 0) or np.any(rows >= sizes[None, :]):
+            raise ValueError(f"{edit.kind}: feature index out of range")
+        e = rows.shape[0]
+        assignments = np.concatenate([assignments, rows], axis=0)
+        # joiners arrive as fresh household classes (the conservative
+        # reading: churn does not merge households)
+        base = int(household_id.max()) + 1 if household_id.size else 0
+        household_id = np.concatenate(
+            [household_id, base + np.arange(e, dtype=np.int32)]
+        )
+    elif edit.kind == "agents_drop":
+        drop = np.unique(np.asarray(edit.agents, dtype=np.int64))
+        if drop.size == 0 or drop.min() < 0 or drop.max() >= reg.n:
+            raise ValueError("agents_drop: agent ids out of range")
+        if np.intersect1d(drop, witness).size:
+            raise ValueError(
+                "agents_drop: dropping a witness member would void the "
+                "feasibility certificate"
+            )
+        keep = np.ones(reg.n, dtype=bool)
+        keep[drop] = False
+        assignments = assignments[keep]
+        household_id = household_id[keep]
+        # witness ids shift down past each dropped agent
+        witness = witness - np.searchsorted(drop, witness)
+    elif edit.kind in ("quota_relax", "quota_tighten"):
+        f = int(edit.cell)
+        if not (0 <= f < len(qmin)):
+            raise ValueError(f"{edit.kind}: cell {f} out of range")
+        lo = int(qmin[f]) + int(edit.dlo)
+        hi = int(qmax[f]) + int(edit.dhi)
+        lo, hi = max(0, lo), min(int(reg.k), hi)
+        if lo > hi:
+            raise ValueError(f"{edit.kind}: band [{lo}, {hi}] is empty")
+        qmin[f], qmax[f] = lo, hi
+    else:
+        raise ValueError(f"unknown edit kind {edit.kind!r} (see EDIT_KINDS)")
+
+    return Registry(
+        name=reg.name,
+        k=reg.k,
+        categories=reg.categories,
+        features=feats,
+        assignments=assignments,
+        qmin=qmin,
+        qmax=qmax,
+        household_id=household_id,
+        witness=witness,
+        seed=reg.seed,
+    )
+
+
+def churn_trail(
+    reg: Registry,
+    n_edits: int,
+    seed: int = 0,
+    max_edit_agents: int = 64,
+    max_new_types: int = 3,
+    weights: Optional[dict] = None,
+) -> List[RegistryEdit]:
+    """Seeded churn trail: ``n_edits`` edits whose SEQUENTIAL application
+    keeps every intermediate registry witness-feasible.
+
+    The generator simulates each candidate edit on a working copy before
+    emitting it, so the guarantee is by construction, not by hope:
+
+    * agent adds/joins copy feature rows of existing agents (no accidental
+      new types) and never touch quotas;
+    * drops avoid witness members;
+    * tighten edits only move a band edge TOWARD the witness count, never
+      past it; relax edits widen within ``[0, k]``;
+    * ``new_type`` appends a feature with band ``[0, hi]`` (the witness has
+      zero of it) and is capped at ``max_new_types`` per trail so the type
+      space stays enumerable.
+
+    Deterministic in ``(reg, n_edits, seed, …)``: the same inputs always
+    yield the identical trail (``numpy.default_rng``, no global state).
+    """
+    rng = np.random.default_rng(seed)
+    w = dict(weights or {
+        "agents_add": 0.30,
+        "agents_drop": 0.28,
+        "quota_relax": 0.16,
+        "quota_tighten": 0.16,
+        "new_type": 0.10,
+    })
+    kinds = [kk for kk in EDIT_KINDS if w.get(kk, 0.0) > 0]
+    probs = np.asarray([w[kk] for kk in kinds], dtype=np.float64)
+    probs = probs / probs.sum()
+
+    cur = reg
+    new_types = 0
+    trail: List[RegistryEdit] = []
+    while len(trail) < n_edits:
+        kind = kinds[int(rng.choice(len(kinds), p=probs))]
+        edit: Optional[RegistryEdit] = None
+        if kind == "new_type" and new_types >= max_new_types:
+            kind = "agents_add"
+        if kind == "agents_add":
+            e = int(rng.integers(1, max_edit_agents + 1))
+            src = rng.integers(0, cur.n, size=e)
+            edit = RegistryEdit(
+                kind="agents_add", rows=cur.assignments[src].copy()
+            )
+        elif kind == "agents_drop":
+            mask = np.ones(cur.n, dtype=bool)
+            mask[cur.witness] = False
+            pool = np.nonzero(mask)[0]
+            if pool.size == 0:
+                continue
+            e = int(min(rng.integers(1, max_edit_agents + 1), pool.size))
+            edit = RegistryEdit(
+                kind="agents_drop",
+                agents=np.sort(rng.choice(pool, size=e, replace=False)).astype(
+                    np.int64
+                ),
+            )
+        elif kind in ("quota_relax", "quota_tighten"):
+            f = int(rng.integers(0, len(cur.qmin)))
+            wc = int(cur.incidence()[cur.witness].sum(axis=0)[f])
+            lo, hi = int(cur.qmin[f]), int(cur.qmax[f])
+            if kind == "quota_tighten":
+                dlo = 1 if lo < wc else 0
+                dhi = -1 if hi > wc else 0
+                if dlo == 0 and dhi == 0:
+                    kind = "quota_relax"
+                else:
+                    edit = RegistryEdit(
+                        kind="quota_tighten", cell=f, dlo=dlo, dhi=dhi
+                    )
+            if kind == "quota_relax":
+                dlo = -1 if lo > 0 else 0
+                dhi = 1 if hi < cur.k else 0
+                if dlo == 0 and dhi == 0:
+                    continue
+                edit = RegistryEdit(kind="quota_relax", cell=f, dlo=dlo, dhi=dhi)
+        elif kind == "new_type":
+            c = int(rng.integers(0, cur.n_categories))
+            e = int(rng.integers(1, 9))
+            new_slot = len(cur.features[c])
+            src = rng.integers(0, cur.n, size=e)
+            rows = cur.assignments[src].copy()
+            rows[:, c] = new_slot
+            edit = RegistryEdit(
+                kind="new_type",
+                rows=rows,
+                category=c,
+                feature=f"{cur.categories[c]}_new{new_types}",
+                dhi=int(rng.integers(1, 4)),
+            )
+        if edit is None:
+            continue
+        nxt = apply_edit(cur, edit)
+        if not nxt.check_witness():  # pragma: no cover - defensive
+            raise AssertionError(
+                f"churn_trail generated an infeasible edit: {edit.describe()}"
+            )
+        if edit.kind == "new_type":
+            new_types += 1
+        trail.append(edit)
+        cur = nxt
+    return trail

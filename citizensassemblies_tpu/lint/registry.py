@@ -93,6 +93,7 @@ MANIFEST: Tuple[str, ...] = (
     "citizensassemblies_tpu.parallel.solver",
     "citizensassemblies_tpu.parallel.sweep",
     "citizensassemblies_tpu.solvers.batch_lp",
+    "citizensassemblies_tpu.solvers.delta",
     "citizensassemblies_tpu.solvers.device_pricing",
     "citizensassemblies_tpu.solvers.face_decompose",
     "citizensassemblies_tpu.solvers.lp_pdhg",

@@ -245,6 +245,11 @@ def _typespace_leximin(
     # the final LP with closed-form pricing (top-c_t dual weights per type);
     # a basic optimal solution is sparse (≤ n+1 panels, comparable to the
     # reference's portfolios) and ε converges to ~0
+    if final_stage != "l2":
+        return realize_typespace(
+            dense, reduction, ts, cfg, log, households=households,
+            enumerated=comps is not None,
+        )
     with log.timer("final_stage"):
         if final_stage == "l2":
             from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
@@ -290,64 +295,7 @@ def _typespace_leximin(
                 P, fixed_agent, iters=cfg.xmin_qp_iters, log=log,
                 floor_donor=p_seed, cfg=cfg,
             )
-        else:
-            from citizensassemblies_tpu.solvers.compositions import decompose_with_pricing
-
-            # decompose toward the marginals the composition mixture actually
-            # realizes (within ts.eps_dev of the type values): the greedy
-            # water-filling is near-exact against those, whereas targeting
-            # the type values directly would leave the mixture's own ε as an
-            # unservable shortfall and push everything into the polish LPs
-            realized = ts.probabilities @ (
-                ts.compositions.astype(np.float64)
-                / reduction.msize.astype(np.float64)[None, :]
-            )
-            P, probs, eps_dev = decompose_with_pricing(
-                ts.compositions,
-                ts.probabilities,
-                reduction,
-                realized[reduction.type_id],
-                budget=cfg.decompose_budget,
-                support_eps=cfg.support_eps,
-                log=log,
-                households=households,
-                # enumerated path polishes to 1e-6 (500× below the
-                # reference's own EPS=5e-4 final-LP tolerance — chasing
-                # 1e-9 cost ~30 extra host LPs for precision nothing
-                # downstream can see); the CG path floors the panel
-                # tolerance at 2e-5 (its greedy noise scale). On LARGE
-                # instances (n ≥ 200) — on EITHER path — the tolerance
-                # never drops below 2.5e-4 just because the mixture's own ε
-                # is tiny: precision the 1e-3 contract cannot see. A
-                # nexus-class CG polish paid ~18 LPs at ~1 s for it, and an
-                # enumerated n=469/k=90 single-category instance was worse
-                # still — the greedy seed's panel budget scales with
-                # 1/delta_cap = 1/(1.5·tol), so tol = 1e-6 built a ~6000-
-                # panel portfolio whose ~940×6000 polish LPs took ~20 s
-                # each while shaving ε ~5 %/round: a many-minute stall on
-                # a sub-second instance. Small instances keep the tight
-                # bound (the polish is ~0.1 s there). Otherwise budget
-                # against the mixture ε: total contract error |alloc − v| ≤
-                # tol_panel + eps_dev ≤ accept_band + 1e-4 (= 9e-4 < 1e-3
-                # at the default config; derived from cfg so the knobs
-                # cannot silently drift past the contract).
-                tol=max(
-                    cfg.decomp_tol if comps is not None else max(cfg.decomp_tol, 2e-5),
-                    min(
-                        max(
-                            0.5 * getattr(ts, "eps_dev", 0.0),
-                            2.5e-4 if dense.n >= 200 else 0.0,
-                        ),
-                        max(cfg.decomp_accept, cfg.decomp_accept_stalled)
-                        + 1e-4
-                        - getattr(ts, "eps_dev", 0.0),
-                    ),
-                ),
-            )
     probs = np.clip(probs, 0.0, 1.0)
-    keep = probs > cfg.support_eps
-    if final_stage != "l2":
-        P, probs = P[keep], probs[keep]
     probs = probs / probs.sum()
     allocation = P.T.astype(np.float64) @ probs
     coverable = (
@@ -360,7 +308,119 @@ def _typespace_leximin(
         f"{P.shape[0]} panels in portfolio, final ε = {eps_dev:.2e}, "
         f"max |alloc − target| = {total_dev:.2e}."
     )
-    if final_stage != "l2" and total_dev > 1e-3:
+    log.emit(format_timers(log.timers))
+    if log.counters:
+        # the pipelined decomposition's warm-hit / overlap attribution
+        # (decomp_master_warm, decomp_oracle_overlap_hit, ...) — the discrete
+        # complement of the phase timers above
+        log.emit(format_counters(log.counters))
+    # contract_ok reports the realized deviation HONESTLY on every path,
+    # including "l2": the l2 stage never falls back to agent space (its
+    # callers — XMIN, warm-start re-solves — gate the deviation with their
+    # own L∞ band machinery), but with the ε floor now coming from the
+    # decomposition donor instead of a minimal-ε LP, a stalled donor must
+    # surface as contract_ok=False rather than ship silently certified
+    return Distribution(
+        committees=P,
+        probabilities=probs,
+        allocation=allocation,
+        output_lines=list(log.lines),
+        fixed_probabilities=fixed_agent,
+        covered=covered,
+        realization_dev=total_dev,
+        contract_ok=bool(total_dev <= 1e-3),
+    )
+
+
+def realize_typespace(
+    dense: DenseInstance,
+    reduction,
+    ts,
+    cfg: Config,
+    log: RunLog,
+    households: Optional[np.ndarray] = None,
+    enumerated: bool = True,
+) -> Distribution:
+    """Realize a type-space leximin certificate as a concrete panel portfolio.
+
+    Factored out of ``_typespace_leximin`` so the graftdelta revise path
+    (``solvers/delta.py``) can turn a re-certified ``TypeLeximin`` into a
+    full :class:`Distribution` without re-running the ladder: the input is
+    any (compositions, probabilities, type_values) certificate over
+    ``reduction``, whether it came from a fresh ladder, a warm resume, or a
+    cache-hit sensitivity certificate.
+    """
+    from citizensassemblies_tpu.solvers.compositions import decompose_with_pricing
+
+    fixed_agent = ts.type_values[reduction.type_id]
+    with log.timer("final_stage"):
+        # decompose toward the marginals the composition mixture actually
+        # realizes (within ts.eps_dev of the type values): the greedy
+        # water-filling is near-exact against those, whereas targeting
+        # the type values directly would leave the mixture's own ε as an
+        # unservable shortfall and push everything into the polish LPs
+        realized = ts.probabilities @ (
+            ts.compositions.astype(np.float64)
+            / reduction.msize.astype(np.float64)[None, :]
+        )
+        P, probs, eps_dev = decompose_with_pricing(
+            ts.compositions,
+            ts.probabilities,
+            reduction,
+            realized[reduction.type_id],
+            budget=cfg.decompose_budget,
+            support_eps=cfg.support_eps,
+            log=log,
+            households=households,
+            # enumerated path polishes to 1e-6 (500× below the
+            # reference's own EPS=5e-4 final-LP tolerance — chasing
+            # 1e-9 cost ~30 extra host LPs for precision nothing
+            # downstream can see); the CG path floors the panel
+            # tolerance at 2e-5 (its greedy noise scale). On LARGE
+            # instances (n ≥ 200) — on EITHER path — the tolerance
+            # never drops below 2.5e-4 just because the mixture's own ε
+            # is tiny: precision the 1e-3 contract cannot see. A
+            # nexus-class CG polish paid ~18 LPs at ~1 s for it, and an
+            # enumerated n=469/k=90 single-category instance was worse
+            # still — the greedy seed's panel budget scales with
+            # 1/delta_cap = 1/(1.5·tol), so tol = 1e-6 built a ~6000-
+            # panel portfolio whose ~940×6000 polish LPs took ~20 s
+            # each while shaving ε ~5 %/round: a many-minute stall on
+            # a sub-second instance. Small instances keep the tight
+            # bound (the polish is ~0.1 s there). Otherwise budget
+            # against the mixture ε: total contract error |alloc − v| ≤
+            # tol_panel + eps_dev ≤ accept_band + 1e-4 (= 9e-4 < 1e-3
+            # at the default config; derived from cfg so the knobs
+            # cannot silently drift past the contract).
+            tol=max(
+                cfg.decomp_tol if enumerated else max(cfg.decomp_tol, 2e-5),
+                min(
+                    max(
+                        0.5 * getattr(ts, "eps_dev", 0.0),
+                        2.5e-4 if dense.n >= 200 else 0.0,
+                    ),
+                    max(cfg.decomp_accept, cfg.decomp_accept_stalled)
+                    + 1e-4
+                    - getattr(ts, "eps_dev", 0.0),
+                ),
+            ),
+        )
+    probs = np.clip(probs, 0.0, 1.0)
+    keep = probs > cfg.support_eps
+    P, probs = P[keep], probs[keep]
+    probs = probs / probs.sum()
+    allocation = P.T.astype(np.float64) @ probs
+    coverable = (
+        ts.coverable if hasattr(ts, "coverable") else ts.compositions.max(axis=0) > 0
+    )
+    covered = coverable[reduction.type_id]
+    total_dev = float(np.max(np.abs(allocation - fixed_agent)))
+    log.emit(
+        f"Leximin done (type space): {ts.stages} stages, {ts.lp_solves} LP solves, "
+        f"{P.shape[0]} panels in portfolio, final ε = {eps_dev:.2e}, "
+        f"max |alloc − target| = {total_dev:.2e}."
+    )
+    if total_dev > 1e-3:
         # the panel realization missed the framework's 1e-3 L∞ contract
         # (e.g. a stalled household-disjoint pricing loop): never ship it
         # silently — the caller falls back to the agent-space CG, which is
@@ -379,12 +439,6 @@ def _typespace_leximin(
         # (decomp_master_warm, decomp_oracle_overlap_hit, ...) — the discrete
         # complement of the phase timers above
         log.emit(format_counters(log.counters))
-    # contract_ok reports the realized deviation HONESTLY on every path,
-    # including "l2": the l2 stage never falls back to agent space (its
-    # callers — XMIN, warm-start re-solves — gate the deviation with their
-    # own L∞ band machinery), but with the ε floor now coming from the
-    # decomposition donor instead of a minimal-ε LP, a stalled donor must
-    # surface as contract_ok=False rather than ship silently certified
     return Distribution(
         committees=P,
         probabilities=probs,

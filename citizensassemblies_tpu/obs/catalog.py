@@ -97,6 +97,17 @@ METRIC_SERIES: Dict[str, str] = {
     "graftserve_batcher_solves_per_dispatch": "member solves per cross-request dispatch",
     "graftserve_tenant_evictions": "session-LRU evictions, by owning tenant",
     "graftserve_slo_breach_total": "SLO objective breaches streamed to channels, by tenant and objective",
+    # --- graftdelta incremental re-certification (solvers/delta.py) ------
+    "delta_cache_hit": "edits served by the sensitivity cache certificate (zero LP solves)",
+    "delta_resume": "edits served by a warm ladder resume from a stored stage certificate",
+    "delta_resume_stages": "ladder stages actually re-run across warm resumes",
+    "delta_full_ladder": "edits that re-ran the full ladder over the screened hull",
+    "delta_fallback": "revise requests served from-scratch (cold session, oversized or inconsistent edit)",
+    "delta_new_columns": "columns admitted by incremental region enumeration",
+    "delta_screen_drop": "columns pruned by the feasibility screen",
+    "delta_screen_flag": "near-margin columns re-priced on host in float64",
+    "delta_recertify": "whole delta re-certification step (timer)",
+    "delta_screen": "batched dual screening dispatch (timer)",
     # --- graftscope memory ledger (obs/memory.py) ------------------------
     "mem_live_bytes": "bytes held by live jax arrays at the last ledger snapshot",
     "mem_hbm_peak_bytes": "device-memory high watermark over the ledger's window",

@@ -49,6 +49,7 @@ _DETAIL_RE = re.compile(r"BENCH_detail_r(\d+)\.json$")
 _SERVE_RE = re.compile(r"BENCH_serve_r(\d+)\.json$")
 _KERNELS_RE = re.compile(r"BENCH_kernels_r(\d+)\.json$")
 _ROOFLINE_RE = re.compile(r"ROOFLINE_r(\d+)\.json$")
+_CHURN_RE = re.compile(r"BENCH_churn_r(\d+)\.json$")
 
 
 @dataclasses.dataclass
@@ -200,6 +201,14 @@ def collect_series(root) -> Tuple[Dict[str, List[Tuple[int, float]]], List[int]]
         # graftscope roofline family (bench.py --roofline): per-core
         # dispatch seconds under {"detail": {"roofline_<core>": …}}
         m = _ROOFLINE_RE.search(path.name)
+        if m:
+            rows = _load_offline(path)
+            if rows:
+                by_round.setdefault(int(m.group(1)), {}).update(rows)
+    for path in sorted(root.glob("BENCH_churn_r*.json")):
+        # graftdelta churn family (bench.py --churn): per-edit-class delta
+        # medians + the sampled from-scratch arm, same detail schema
+        m = _CHURN_RE.search(path.name)
         if m:
             rows = _load_offline(path)
             if rows:

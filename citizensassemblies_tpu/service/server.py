@@ -83,6 +83,12 @@ class SelectionRequest:
     seed: int = 0
     dropout: Optional[np.ndarray] = None
     rounds: Optional[int] = None
+    #: graftdelta: a ``solvers.delta.ReviseSpec`` (one registry edit against
+    #: an identified base solve). Only meaningful with algorithm="leximin";
+    #: the service re-certifies incrementally when the tenant session holds
+    #: the base certificate, and falls back BIT-IDENTICALLY to from-scratch
+    #: when it cannot (cold session, oversized edit, Config.delta_solve=False)
+    revise: Any = None
 
 
 @dataclasses.dataclass
@@ -757,6 +763,8 @@ class SelectionService:
                 find_distribution_leximin,
             )
 
+            if request.revise is not None:
+                return self._serve_revise(request, dense, space, ctx, fp)
             return find_distribution_leximin(
                 dense, space, cfg=ctx.cfg, households=request.households,
                 log=ctx.log,
@@ -802,6 +810,137 @@ class SelectionService:
             f"unknown algorithm {algo!r} (legacy|leximin|xmin|dropout|multi)"
         )
 
+    def _serve_revise(self, request: SelectionRequest, dense, space, ctx, fp: str):
+        """graftdelta front door: serve a ``revise`` request incrementally.
+
+        Decision ladder:
+
+        * ``Config.delta_solve=False`` — hard off: run the plain leximin
+          path, BIT-IDENTICAL to a request without ``revise`` (pinned by
+          test), never touching the delta store;
+        * spec inconsistent with the request instance (the edited registry's
+          content fingerprint must equal the request's) — from-scratch,
+          WITHOUT priming: a wrong spec must never seed future deltas;
+        * cold session / edit above ``delta_max_edit_frac`` / household
+          quotient — from-scratch answer (``delta_fallback``), then prime
+          the delta store with a base certificate so the NEXT edit on this
+          instance re-certifies warm;
+        * warm — ``recertify`` (cache hit / resume / screened full ladder),
+          project the certificate onto the request's reduction, realize the
+          panel portfolio, stamp ``delta_cert`` on the audit, store the
+          successor state under the post-edit fingerprint.
+
+        Every fallback is the exact from-scratch solver — a delta answer is
+        only ever served under a verified certificate.
+        """
+        from citizensassemblies_tpu.data.registry import apply_edit
+        from citizensassemblies_tpu.models.leximin import (
+            find_distribution_leximin,
+            realize_typespace,
+        )
+        from citizensassemblies_tpu.solvers import delta as graftdelta
+        from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+        from citizensassemblies_tpu.utils.checkpoint import problem_fingerprint
+
+        cfg, log, spec = ctx.cfg, ctx.log, request.revise
+        gate = getattr(cfg, "delta_solve", None)
+
+        def from_scratch():
+            return find_distribution_leximin(
+                dense, space, cfg=cfg, households=request.households,
+                log=log,
+            )
+
+        if gate is False:
+            return from_scratch()
+
+        # fingerprints are computed with the REQUEST's config (the one the
+        # memo/delta stores key by), not a retry-degraded ctx.cfg
+        cfg0 = request.cfg or self.cfg
+
+        # consistency: the edited registry must BE the request instance —
+        # an inconsistent spec can never be served delta results (and never
+        # primes the store either)
+        try:
+            reg_after = apply_edit(spec.reg_before, spec.edit)
+            dense_after, _ = reg_after.to_dense()
+            fp_after = problem_fingerprint(
+                dense_after, cfg0, request.households
+            )
+        except Exception as exc:
+            log.count("delta_fallback")
+            log.emit(f"graftdelta: invalid revise spec ({exc}); from-scratch.")
+            return from_scratch()
+        if fp_after != fp:
+            log.count("delta_fallback")
+            log.emit(
+                "graftdelta: revise spec inconsistent with the request "
+                "instance (fingerprint mismatch); from-scratch."
+            )
+            return from_scratch()
+
+        def fallback(reason: str):
+            log.count("delta_fallback")
+            if gate is True:
+                # delta_solve=True is the LOUD mode: every fallback explains
+                # itself in the request log (None falls back silently)
+                log.emit(f"graftdelta: {reason}; serving from-scratch.")
+            result = from_scratch()
+            # prime the store so the NEXT edit re-certifies warm (consistent
+            # spec only — certify_base returns None outside the enumerable
+            # delta envelope)
+            if ctx.session is not None:
+                state = graftdelta.certify_base(
+                    reg_after, cfg=cfg, log=log, fingerprint=fp
+                )
+                if state is not None:
+                    ctx.session.delta_put(
+                        fp, state, request_id=ctx.request_id
+                    )
+            return result
+
+        if request.households is not None:
+            # the delta certificate lives in plain type space; the household
+            # quotient augments the instance, so it takes the exact path
+            return fallback("household quotient not on the delta path")
+        base_fp = spec.base_fingerprint
+        if not base_fp:
+            dense_before, _ = spec.reg_before.to_dense()
+            base_fp = problem_fingerprint(
+                dense_before, cfg0, request.households
+            )
+        frac = float(getattr(cfg, "delta_max_edit_frac", 0.05))
+        if int(spec.edit.magnitude) > max(1.0, frac * dense.n):
+            return fallback(
+                f"edit magnitude {spec.edit.magnitude} above "
+                f"delta_max_edit_frac ({frac:g} of n={dense.n})"
+            )
+        state = None
+        if ctx.session is not None:
+            state = ctx.session.delta_get(base_fp)
+        if state is None:
+            return fallback("no base certificate in the tenant session")
+
+        outcome = graftdelta.recertify(
+            state, spec.edit, spec.reg_before, cfg=cfg, log=log,
+            fingerprint=fp,
+        )
+        if outcome is None:
+            return fallback("edit left the delta envelope")
+        reduction = TypeReduction(dense)
+        ts = graftdelta.project_to_reduction(outcome.state, reduction)
+        if ts is None:
+            return fallback("certificate does not project onto the instance")
+        result = realize_typespace(
+            dense, reduction, ts, cfg, log, households=None, enumerated=True,
+        )
+        result.delta_cert = outcome.cert
+        if ctx.session is not None:
+            ctx.session.delta_put(
+                fp, outcome.state, request_id=ctx.request_id
+            )
+        return result
+
     def _finish(
         self,
         request: SelectionRequest,
@@ -846,6 +985,11 @@ class SelectionService:
         # counts, fallback reasons, MC realization stamps, pair gauges
         if hasattr(result, "scenario_audit"):
             audit["scenario"] = dict(result.scenario_audit)
+        # graftdelta: how an incremental re-certification obtained this
+        # answer (cache_hit | resume | full_ladder) with its screen stats,
+        # drift and ε bound — the served certificate, auditable per request
+        if hasattr(result, "delta_cert"):
+            audit["delta_cert"] = dict(result.delta_cert)
         if ctx.session is not None:
             audit["session"] = ctx.session.stats()
             audit["tenant_memo_evictions"] = memo_evictions_by_owner().get(

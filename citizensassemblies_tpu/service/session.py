@@ -47,13 +47,23 @@ class TenantSession:
         self.memo: LRU = LRU(cap=cap, name=f"{self.owner}:memo")
         #: content hash → EllPack
         self.packs: LRU = LRU(cap=cap, name=f"{self.owner}:packs")
+        #: instance content fingerprint → DeltaState (solvers/delta): the
+        #: graftdelta base certificate a ``revise`` request re-certifies
+        #: against. Fingerprint-keying is the staleness contract — a revised
+        #: instance has a different fingerprint, so it can never pick up the
+        #: pre-edit portfolio by accident
+        self.delta: LRU = LRU(cap=cap, name=f"{self.owner}:delta")
         #: pack keys written per in-flight request (request_id → [keys]) —
         #: the rollback ledger: a request that fails mid-solve may have
         #: half-useful packs in the session, and its teardown removes
         #: exactly what it wrote (``rollback_request``)
         self._pack_writes: Dict[str, list] = {}
+        #: delta-state keys written per in-flight request — same rollback
+        #: discipline as ``_pack_writes``
+        self._delta_writes: Dict[str, list] = {}
         self.memo_hits = 0
         self.pack_hits = 0
+        self.delta_hits = 0
 
     # --- warm-slot stores ---------------------------------------------------
 
@@ -96,6 +106,25 @@ class TenantSession:
             if request_id is not None:
                 self._pack_writes.setdefault(request_id, []).append(key)
 
+    # --- graftdelta base certificates ---------------------------------------
+
+    def delta_get(self, fingerprint: str):
+        """The stored :class:`~citizensassemblies_tpu.solvers.delta.DeltaState`
+        certified for exactly this instance fingerprint, or None."""
+        with self._lock:
+            hit = self.delta.get(fingerprint)
+            if hit is not None:
+                self.delta_hits += 1
+            return hit
+
+    def delta_put(
+        self, fingerprint: str, state, request_id: Optional[str] = None
+    ) -> None:
+        with self._lock:
+            self.delta.put(fingerprint, state, owner=self.owner)
+            if request_id is not None:
+                self._delta_writes.setdefault(request_id, []).append(fingerprint)
+
     # --- request-scoped rollback (robust) -----------------------------------
 
     def finish_request(self, request_id: str) -> None:
@@ -103,6 +132,7 @@ class TenantSession:
         drop its rollback ledger and keep everything it cached."""
         with self._lock:
             self._pack_writes.pop(request_id, None)
+            self._delta_writes.pop(request_id, None)
 
     def rollback_request(self, request_id: str) -> None:
         """Failure path: remove the request's warm-slot store and every
@@ -112,6 +142,8 @@ class TenantSession:
             self.warm_stores.pop(request_id, None)
             for key in self._pack_writes.pop(request_id, []):
                 self.packs.pop(key, None)
+            for key in self._delta_writes.pop(request_id, []):
+                self.delta.pop(key, None)
 
     def stats(self) -> Dict[str, int]:
         """Session-level accounting for the audit stamp."""
@@ -119,13 +151,16 @@ class TenantSession:
             return {
                 "memo_entries": len(self.memo),
                 "pack_entries": len(self.packs),
+                "delta_entries": len(self.delta),
                 "warm_stores": len(self.warm_stores),
                 "memo_hits": self.memo_hits,
                 "pack_hits": self.pack_hits,
+                "delta_hits": self.delta_hits,
                 "evictions": (
                     self.warm_stores.evictions
                     + self.memo.evictions
                     + self.packs.evictions
+                    + self.delta.evictions
                 ),
             }
 

@@ -113,6 +113,19 @@ def enumerate_compositions(
 
 
 @dataclasses.dataclass
+class StageCert:
+    """Dual certificate of one leximin stage, captured for graftdelta
+    (``solvers/delta.py``): enough to decide, after a registry edit, whether
+    the stage's optimal face can have changed — and to resume the ladder
+    from exactly this point when it has."""
+
+    z: float  # stage value (the min the stage maximized)
+    y: np.ndarray  # float64 [T] dual weights scattered over ALL types
+    mu: float  # max column price max_c Σ_t y_t·c_t/m_t (the support price)
+    fixed_after: np.ndarray  # float64 [T] fixed vector AFTER the stage (-1 ⇒ open)
+
+
+@dataclasses.dataclass
 class TypeLeximin:
     """Result of the enumerated type-space leximin solve."""
 
@@ -122,6 +135,9 @@ class TypeLeximin:
     eps_dev: float  # max downward deviation of the final distribution
     stages: int
     lp_solves: int
+    #: per-stage dual certificates, present only when the caller asked for
+    #: them (``capture_certs=True``) — the delta solver's re-pricing basis
+    stage_certs: Optional[List[StageCert]] = None
 
 
 _SLACK = 1e-9  # constraint slack absorbing LP solver round-off
@@ -209,6 +225,8 @@ def leximin_over_compositions(
     probe_tol: float = 1e-7,
     log: Optional[RunLog] = None,
     cfg=None,
+    fixed_init: Optional[np.ndarray] = None,
+    capture_certs: bool = False,
 ) -> TypeLeximin:
     """Exact leximin over the full composition enumeration.
 
@@ -234,14 +252,26 @@ def leximin_over_compositions(
     host LPs outright. The screen never certifies — every surviving
     candidate keeps its float64 host confirm — so the certification
     contract is unchanged; only the host-LP count drops.
+
+    ``fixed_init`` warm-starts the fixing ladder: entries ≥ 0 are taken as
+    already-fixed type values (a prefix of a previous solve's trajectory,
+    graftdelta's resume point), ``-1`` entries stay open — ``None`` is
+    identical to the all-open default. ``capture_certs=True`` additionally
+    records a :class:`StageCert` per stage on the result.
     """
     log = log or RunLog(echo=False)
     C, T = comps.shape
     M = comps.astype(np.float64) / np.asarray(msize, dtype=np.float64)[None, :]
     MT = np.ascontiguousarray(M.T)  # [T, C]
-    fixed = np.full(T, -1.0)
+    if fixed_init is not None:
+        fixed = np.asarray(fixed_init, dtype=np.float64).copy()
+        if fixed.shape != (T,):
+            raise ValueError(f"fixed_init must be float [{T}]")
+    else:
+        fixed = np.full(T, -1.0)
     coverable = comps.max(axis=0) > 0 if C else np.zeros(T, dtype=bool)
-    fixed[~coverable] = 0.0
+    fixed[~coverable & (fixed < 0)] = 0.0
+    certs: List[StageCert] = [] if capture_certs else None
     if (~coverable).any():
         log.emit(
             f"{int((~coverable).sum())} type(s) appear in no feasible committee; "
@@ -358,6 +388,21 @@ def leximin_over_compositions(
         if not tranche.any():
             tranche[np.argmax(y)] = True  # progress guard
         fixed[unfixed[tranche]] = max(0.0, z)
+        if capture_certs:
+            marg = -np.asarray(res.ineqlin.marginals, dtype=np.float64)
+            y_full = np.zeros(T)
+            y_full[unfixed] = marg[:nu]
+            if nd:
+                y_full[done] = marg[nu:]
+            prices = M @ y_full
+            certs.append(
+                StageCert(
+                    z=z,
+                    y=y_full,
+                    mu=float(prices.max()) if C else 0.0,
+                    fixed_after=fixed.copy(),
+                )
+            )
         log.emit(
             f"Stage {stages}: value {z:.6f}, fixed {int(tranche.sum())} type(s), "
             f"{int((fixed >= 0).sum())}/{T} done."
@@ -383,6 +428,7 @@ def leximin_over_compositions(
         eps_dev=float(res.x[C]),
         stages=stages,
         lp_solves=lp_solves,
+        stage_certs=certs,
     )
 
 

@@ -390,6 +390,27 @@ class Config:
     #: always retained, so a long-running request's stream cannot grow
     #: without bound.
     serve_channel_cap: int = 1024
+    #: graftdelta incremental re-certification, tri-state. ``False`` = hard
+    #: off: ``revise`` requests run the plain from-scratch solver and never
+    #: touch the session delta store — bit-identical to pre-delta builds
+    #: (pinned by test). ``None`` (auto) = serve delta re-certification when
+    #: the tenant session holds a matching base certificate (warm), fall
+    #: back to from-scratch (and prime the store) when cold. ``True`` = same
+    #: as auto but a cold or oversized revise counts a ``delta_fallback``
+    #: loudly so operators can see missed O(edit) opportunities.
+    delta_solve: Optional[bool] = None
+    #: largest edit the delta path accepts, as a fraction of the pool size
+    #: (``edit.magnitude / n``). Past it the screen/resume machinery would
+    #: approach from-scratch cost anyway, so the service falls back
+    #: bit-identically to the full solver (counted ``delta_fallback``).
+    delta_max_edit_frac: float = 0.05
+    #: slack consumed by the dual-sensitivity cache certificate. A cache hit
+    #: (zero LP solves) is only claimed when every newly-admitted column
+    #: prices at least this far below the stage support price AND the
+    #: allocation drift bound from pool-size changes stays under it, with
+    #: ``eps_old + 2·margin`` still inside the 1e-3 L∞ contract. Smaller =
+    #: fewer cache hits, never a weaker contract.
+    delta_cert_margin: float = 2.0e-4
 
     # --- observability (citizensassemblies_tpu/obs) ----------------------------
     #: grafttrace span tracing, tri-state. ``False`` = hard off: the span

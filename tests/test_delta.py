@@ -1,0 +1,423 @@
+"""graftdelta: incremental re-certification under registry churn.
+
+What is pinned here:
+
+* **Churn-trail contract** — seeded trails are deterministic and keep every
+  intermediate registry witness-feasible, across seeds.
+* **Type-system O(edit) projection** — ``TypeSystem.update`` after a trail
+  agrees with ``TypeSystem.from_registry`` rebuilt from scratch.
+* **Delta soundness per edit class** — the delta answer matches a
+  from-scratch re-certification within the 1e-3 L∞ contract for every edit
+  kind, along a sequential trail.
+* **Cache-hit certificate** — a claimed zero-LP cache hit is validated
+  against an ACTUAL re-solve (the drift bound is checked, not trusted).
+* **Warm resume** — a pinned natural instance resumes from stage 1 and
+  re-runs exactly the invalidated suffix, matching from-scratch.
+* **Ladder resume hooks** — ``fixed_init``/``capture_certs`` leave the
+  default path bit-identical, and resuming from a stored stage certificate
+  reproduces the full ladder's values exactly.
+* **Service wiring** — ``SelectionRequest(revise=…)`` serves a delta answer
+  with the ``delta_cert`` audit stamp after a priming fallback;
+  ``delta_solve=False`` is bit-identical to a request without ``revise``;
+  session memo/delta stores are fingerprint-keyed (a quota edit ⇒ memo
+  miss — the staleness regression).
+"""
+
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.data.registry import (
+    RegistryEdit,
+    apply_edit,
+    churn_trail,
+    nationwide_registry,
+)
+from citizensassemblies_tpu.solvers import delta as gd
+from citizensassemblies_tpu.utils.config import default_config
+
+
+def _registry(n=1500, k=45, seed=2, regions=6, slack=0.02):
+    return nationwide_registry(
+        n=n,
+        k=k,
+        seed=seed,
+        categories=(("region", [f"r{i}" for i in range(regions)]),),
+        quota_slack=slack,
+    )
+
+
+def _type_linf(state_a, state_b):
+    """L∞ over matched live types == the per-agent L∞ the contract uses."""
+    ia = {
+        tuple(int(v) for v in row): t
+        for t, row in enumerate(state_a.system.type_feature)
+    }
+    worst = 0.0
+    for t_b, row in enumerate(state_b.system.type_feature):
+        if state_b.system.msize[t_b] == 0:
+            continue
+        t_a = ia.get(tuple(int(v) for v in row))
+        if t_a is None:
+            return float("inf")
+        worst = max(
+            worst,
+            abs(float(state_a.type_values[t_a]) - float(state_b.type_values[t_b])),
+        )
+    return worst
+
+
+# --- churn trail --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_churn_trail_deterministic_and_feasible(seed):
+    reg = _registry()
+    trail_a = churn_trail(reg, 20, seed=seed, max_edit_agents=16)
+    trail_b = churn_trail(reg, 20, seed=seed, max_edit_agents=16)
+    assert len(trail_a) == 20
+    for ea, eb in zip(trail_a, trail_b):
+        assert ea.kind == eb.kind and ea.magnitude == eb.magnitude
+        assert ea.describe() == eb.describe()
+    cur = reg
+    for edit in trail_a:
+        cur = apply_edit(cur, edit)
+        assert cur.check_witness(), f"witness infeasible after {edit.describe()}"
+
+
+def test_churn_trail_covers_edit_classes():
+    reg = _registry()
+    kinds = {e.kind for e in churn_trail(reg, 40, seed=3, max_edit_agents=16)}
+    assert {"agents_add", "agents_drop", "quota_relax", "quota_tighten"} <= kinds
+
+
+def test_drop_witness_member_rejected():
+    reg = _registry()
+    edit = RegistryEdit(
+        kind="agents_drop",
+        agents=np.asarray([int(reg.witness[0])], dtype=np.int64),
+    )
+    with pytest.raises(ValueError, match="witness"):
+        apply_edit(reg, edit)
+
+
+# --- type-system projection ---------------------------------------------------
+
+
+def test_typesystem_update_matches_rebuild():
+    reg = _registry()
+    system = gd.TypeSystem.from_registry(reg)
+    cur = reg
+    for edit in churn_trail(reg, 15, seed=5, max_edit_agents=16):
+        system, _ = system.update(edit, cur)
+        cur = apply_edit(cur, edit)
+    rebuilt = gd.TypeSystem.from_registry(cur)
+    assert np.array_equal(system.lo, rebuilt.lo)
+    assert np.array_equal(system.hi, rebuilt.hi)
+    # the incrementally-maintained pool sizes agree type-by-type (update
+    # keeps emptied/appended types in place, so match by feature key)
+    idx = {
+        tuple(int(v) for v in row): t
+        for t, row in enumerate(system.type_feature)
+    }
+    for t_r, row in enumerate(rebuilt.type_feature):
+        t_s = idx.get(tuple(int(v) for v in row))
+        assert t_s is not None
+        assert int(system.msize[t_s]) == int(rebuilt.msize[t_r])
+
+
+# --- delta soundness ----------------------------------------------------------
+
+
+def test_delta_matches_from_scratch_along_trail():
+    cfg = default_config()
+    reg = _registry()
+    state = gd.certify_base(reg, cfg=cfg)
+    assert state is not None
+    checked_kinds = set()
+    cur = reg
+    for edit in churn_trail(reg, 12, seed=11, max_edit_agents=16):
+        nxt = apply_edit(cur, edit)
+        out = gd.recertify(state, edit, cur, cfg=cfg)
+        if out is None:
+            state = gd.certify_base(nxt, cfg=cfg)
+            assert state is not None
+        else:
+            state = out.state
+            assert out.cert["mode"] in ("cache_hit", "resume", "full_ladder")
+            assert out.cert["eps_bound"] <= 1e-3
+        scratch = gd.certify_base(nxt, cfg=cfg)
+        assert scratch is not None
+        linf = _type_linf(state, scratch)
+        assert linf <= 1e-3, f"{edit.describe()}: L∞ {linf:.2e}"
+        checked_kinds.add(edit.kind)
+        cur = nxt
+    assert len(checked_kinds) >= 3  # the trail exercised several classes
+
+
+def test_cache_hit_certificate_validated_against_resolve():
+    # a large pool keeps the drift bound far inside the certificate margin:
+    # a small agent edit must be served by the zero-LP cache certificate
+    cfg = default_config()
+    reg = _registry(n=20_000, k=141, seed=4, regions=8, slack=0.003)
+    state = gd.certify_base(reg, cfg=cfg)
+    assert state is not None
+    rows = reg.assignments[:4].astype(np.int32)
+    edit = RegistryEdit(kind="agents_add", rows=rows)
+    out = gd.recertify(state, edit, reg, cfg=cfg)
+    assert out is not None
+    assert out.cert["mode"] == "cache_hit"
+    assert out.cert["lp_solves"] == 0
+    assert out.state.lp_solves == state.lp_solves  # really no new solves
+    # the certificate's claim, checked against an ACTUAL from-scratch solve
+    scratch = gd.certify_base(apply_edit(reg, edit), cfg=cfg)
+    assert scratch is not None
+    linf = _type_linf(out.state, scratch)
+    assert linf <= 1e-3
+    # the certified bound must cover the observed deviation
+    assert linf <= out.cert["eps_bound"] + 1e-9
+
+
+def test_warm_resume_pinned_instance():
+    # natural resume case: this quota relaxation admits columns that price
+    # into stage 1 but not stage 0 — the ladder resumes from the stored
+    # stage-0 certificate and re-runs exactly the 4-stage suffix
+    cfg = default_config()
+    reg = _registry(n=4000, k=63, seed=0, regions=7, slack=0.01)
+    state = gd.certify_base(reg, cfg=cfg)
+    assert state is not None
+    assert len(state.certs) == 5
+    edit = RegistryEdit(kind="quota_relax", cell=5, dlo=-1, dhi=0)
+    out = gd.recertify(state, edit, reg, cfg=cfg)
+    assert out is not None
+    assert out.cert["mode"] == "resume"
+    assert out.cert["resume_stage"] == 1
+    assert out.cert["stages_rerun"] == 4
+    scratch = gd.certify_base(apply_edit(reg, edit), cfg=cfg)
+    assert _type_linf(out.state, scratch) <= 1e-3
+
+
+def test_tighten_that_kills_support_falls_back_soundly():
+    cfg = default_config()
+    reg = _registry()
+    state = gd.certify_base(reg, cfg=cfg)
+    assert state is not None
+    # slam a cell's band to its witness count: most of the hull dies
+    counts = np.zeros(len(reg.qmin), dtype=int)
+    wrows = reg.assignments[reg.witness]
+    for c in range(reg.n_categories):
+        off = int(reg.cell_offsets[c])
+        vals, cnt = np.unique(wrows[:, c], return_counts=True)
+        counts[off + vals] = cnt
+    cell = 2
+    edit = RegistryEdit(
+        kind="quota_tighten",
+        cell=cell,
+        dlo=int(counts[cell] - reg.qmin[cell]),
+        dhi=int(counts[cell] - reg.qmax[cell]),
+    )
+    nxt = apply_edit(reg, edit)
+    assert nxt.check_witness()
+    out = gd.recertify(state, edit, reg, cfg=cfg)
+    scratch = gd.certify_base(nxt, cfg=cfg)
+    assert scratch is not None
+    if out is None:
+        return  # hull died entirely: the envelope exit is the sound answer
+    assert out.cert["mode"] in ("cache_hit", "resume", "full_ladder")
+    assert _type_linf(out.state, scratch) <= 1e-3
+
+
+# --- ladder resume hooks (solvers/compositions.py) ----------------------------
+
+
+def test_capture_certs_leaves_ladder_unchanged():
+    from citizensassemblies_tpu.solvers.compositions import (
+        leximin_over_compositions,
+    )
+
+    system = gd.TypeSystem.from_registry(_registry())
+    comps = gd._enumerate_region(
+        system,
+        np.zeros(system.T, dtype=np.int64),
+        np.minimum(system.msize, system.k),
+        system.lo,
+        system.hi,
+    )
+    msize = np.maximum(system.msize, 1).astype(np.float64)
+    plain = leximin_over_compositions(comps, msize)
+    with_certs = leximin_over_compositions(comps, msize, capture_certs=True)
+    assert plain.stage_certs is None
+    assert with_certs.stage_certs is not None
+    assert len(with_certs.stage_certs) == with_certs.stages
+    np.testing.assert_array_equal(plain.probabilities, with_certs.probabilities)
+    np.testing.assert_array_equal(plain.type_values, with_certs.type_values)
+    # resuming from the first stage's certificate reproduces the ladder
+    resumed = leximin_over_compositions(
+        comps, msize, fixed_init=with_certs.stage_certs[0].fixed_after
+    )
+    np.testing.assert_allclose(
+        resumed.type_values, with_certs.type_values, atol=1e-9
+    )
+
+
+def test_project_to_reduction_consistency_guard():
+    from citizensassemblies_tpu.solvers.native_oracle import TypeReduction
+
+    cfg = default_config()
+    reg = _registry()
+    state = gd.certify_base(reg, cfg=cfg)
+    dense, _ = reg.to_dense()
+    reduction = TypeReduction(dense)
+    ts = gd.project_to_reduction(state, reduction)
+    assert ts is not None
+    assert ts.compositions.shape == (len(state.comps), reduction.T)
+    # per-agent values through the reduction must match the state's own
+    per_type = ts.probabilities @ (
+        ts.compositions.astype(np.float64)
+        / reduction.msize.astype(np.float64)[None, :]
+    )
+    np.testing.assert_allclose(per_type, ts.type_values, atol=1e-9)
+    # a pool-size mismatch (stale certificate vs a different instance) is
+    # refused rather than projected wrongly
+    bad = gd.DeltaState(
+        system=gd.TypeSystem(
+            k=state.system.k,
+            features=state.system.features,
+            rows=state.system.rows,
+            msize=state.system.msize + 1,
+            lo=state.system.lo,
+            hi=state.system.hi,
+        ),
+        comps=state.comps,
+        probabilities=state.probabilities,
+        type_values=state.type_values,
+        eps_dev=state.eps_dev,
+        certs=state.certs,
+        pack=state.pack,
+    )
+    assert gd.project_to_reduction(bad, reduction) is None
+
+
+# --- service wiring -----------------------------------------------------------
+
+
+def _service_fixture():
+    from citizensassemblies_tpu.service import SelectionRequest, SelectionService
+
+    reg = _registry(n=1200, k=36, seed=9, regions=6, slack=0.02)
+    edits = churn_trail(reg, 2, seed=1, max_edit_agents=8)
+    return SelectionService, SelectionRequest, reg, edits
+
+
+def test_service_revise_round_trip():
+    SelectionService, SelectionRequest, reg, edits = _service_fixture()
+    from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+
+    cfg = default_config()
+    with SelectionService(cfg) as svc:
+        d0, s0 = reg.to_dense()
+        r0 = svc.run(SelectionRequest(dense=d0, space=s0, tenant="t"))
+        assert r0.audit["contract_ok"]
+        assert "delta_cert" not in r0.audit
+        cur, results = reg, []
+        for edit in edits:
+            nxt = apply_edit(cur, edit)
+            dn, sn = nxt.to_dense()
+            rr = svc.run(
+                SelectionRequest(
+                    dense=dn,
+                    space=sn,
+                    tenant="t",
+                    revise=gd.ReviseSpec(edit=edit, reg_before=cur),
+                )
+            )
+            results.append((rr, dn, sn))
+            cur = nxt
+        # first revise: cold session — exact fallback, primes the store
+        assert results[0][0].audit["counters"].get("delta_fallback") == 1
+        assert results[0][0].audit["session"]["delta_entries"] >= 1
+        # second revise: served by the delta path, certificate stamped
+        r2, d2, s2 = results[1]
+        cert = r2.audit["delta_cert"]
+        assert cert["mode"] in ("cache_hit", "resume", "full_ladder")
+        assert r2.audit["contract_ok"]
+        # the served allocation agrees with a from-scratch solve of the
+        # same instance: both sit within 1e-3 of the same exact optimum
+        scratch = find_distribution_leximin(d2, s2, cfg=cfg)
+        assert (
+            np.abs(r2.allocation - scratch.allocation).max()
+            <= 2e-3 + 1e-9
+        )
+
+
+def test_service_revise_inconsistent_spec_falls_back():
+    SelectionService, SelectionRequest, reg, edits = _service_fixture()
+    cfg = default_config()
+    with SelectionService(cfg) as svc:
+        edit = edits[0]
+        nxt = apply_edit(reg, edit)
+        dn, sn = nxt.to_dense()
+        other = churn_trail(reg, 5, seed=99, max_edit_agents=8)[-1]
+        rr = svc.run(
+            SelectionRequest(
+                dense=dn,
+                space=sn,
+                tenant="t",
+                # wrong edit for this instance: must never serve delta
+                revise=gd.ReviseSpec(edit=other, reg_before=reg),
+            )
+        )
+        assert "delta_cert" not in rr.audit
+        assert rr.audit["counters"].get("delta_fallback", 0) >= 1
+        assert rr.audit["contract_ok"]
+
+
+def test_delta_solve_false_bit_identical():
+    SelectionService, SelectionRequest, reg, edits = _service_fixture()
+    cfg = default_config().replace(delta_solve=False)
+    edit = edits[0]
+    nxt = apply_edit(reg, edit)
+    dn, sn = nxt.to_dense()
+    with SelectionService(cfg) as svc:
+        plain = svc.run(
+            SelectionRequest(dense=dn, space=sn, tenant="plain")
+        )
+        revised = svc.run(
+            SelectionRequest(
+                dense=dn,
+                space=sn,
+                tenant="revised",
+                revise=gd.ReviseSpec(edit=edit, reg_before=reg),
+            )
+        )
+        # hard off: the revise request is BIT-identical to a plain request
+        # and never touches the delta store
+        np.testing.assert_array_equal(plain.allocation, revised.allocation)
+        np.testing.assert_array_equal(
+            np.asarray(plain.result.probabilities),
+            np.asarray(revised.result.probabilities),
+        )
+        assert revised.audit["session"]["delta_entries"] == 0
+        assert "delta_cert" not in revised.audit
+        assert "delta_fallback" not in revised.audit["counters"]
+
+
+def test_memo_and_delta_keys_are_content_fingerprints():
+    # the staleness regression: a quota edit changes the instance content
+    # fingerprint, so the revised instance can never hit the old memo or
+    # pick up the old delta state
+    from citizensassemblies_tpu.utils.checkpoint import problem_fingerprint
+
+    SelectionService, SelectionRequest, reg, _ = _service_fixture()
+    cfg = default_config()
+    edit = RegistryEdit(kind="quota_relax", cell=1, dlo=0, dhi=1)
+    nxt = apply_edit(reg, edit)
+    d0, s0 = reg.to_dense()
+    d1, s1 = nxt.to_dense()
+    assert problem_fingerprint(d0, cfg, None) != problem_fingerprint(d1, cfg, None)
+    with SelectionService(cfg) as svc:
+        svc.run(SelectionRequest(dense=d0, space=s0, tenant="t"))
+        again = svc.run(SelectionRequest(dense=d0, space=s0, tenant="t"))
+        assert again.from_memo  # identical instance: memo hit
+        edited = svc.run(SelectionRequest(dense=d1, space=s1, tenant="t"))
+        assert not edited.from_memo  # edited quotas: memo MISS
+        assert edited.audit["session"]["memo_hits"] == 1
