@@ -27,6 +27,20 @@ check-ir:
 update-ir-budget:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m citizensassemblies_tpu.lint --ir --update-budget
 
+# graftspmd (lint/spmd.py): compile every registered core — mesh-consuming
+# cores under 1/2/4/8 virtual devices — and verify the collective census
+# against SPMD_BUDGET.json, the declared dist/partition.py sharding
+# contracts, and precision-flow cert isolation. The census diff lands in
+# SPMD_BUDGET_DIFF.json and the S3 artifact in PRECISION_FLOW.json (both
+# uploaded as CI artifacts).
+check-spmd:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m citizensassemblies_tpu.lint --spmd --diff-out SPMD_BUDGET_DIFF.json --precision-out PRECISION_FLOW.json
+
+# deliberate ratchet move: re-measure every core's collective census and
+# rewrite SPMD_BUDGET.json (S2/S3 still fail)
+update-spmd-budget:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m citizensassemblies_tpu.lint --spmd --update-spmd-budget --precision-out PRECISION_FLOW.json
+
 # grafttrace bench trend gate (obs/trend.py): per-row regression check over
 # the committed BENCH_*.json / BENCH_serve_*.json trajectory. Stdlib-only —
 # no accelerator stack needed, same posture as `lint`.

@@ -750,6 +750,14 @@ def main() -> None:
         result["ir_budget"] = budget_provenance()
     except Exception:  # provenance must never kill a bench run
         result["ir_budget"] = {"error": "unavailable"}
+    # and the SPMD_BUDGET.json collective-census ratchet state (graftspmd) —
+    # the second budget this row's numbers are attributable to
+    try:
+        from citizensassemblies_tpu.lint.spmd import spmd_budget_provenance
+
+        result["spmd_budget"] = spmd_budget_provenance()
+    except Exception:
+        result["spmd_budget"] = {"error": "unavailable"}
     try:
         from citizensassemblies_tpu.utils.memo import memo_evictions
 
@@ -792,6 +800,8 @@ def main() -> None:
     summary = {"detail_file": os.path.basename(str(detail_path))}
     if isinstance(result.get("ir_budget"), dict) and "sha256" in result["ir_budget"]:
         summary["ir_budget"] = result["ir_budget"]["sha256"]
+    if isinstance(result.get("spmd_budget"), dict) and "sha256" in result["spmd_budget"]:
+        summary["spmd_budget"] = result["spmd_budget"]["sha256"]
     flag = {}
     for key in (
         "sf_e_skewed", "sf_e_skewed_seed0", "sf_e_skewed_seed2",

@@ -80,8 +80,41 @@ def bucket(mesh: Mesh, ndim: int) -> NamedSharding:
     )
 
 
+def rows(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Dual-LP row shards (``parallel/solver.py``): the leading
+    (constraint-row) axis over the whole mesh, trailing dims replicated —
+    the layout the sharded PDHG core's ``in_specs`` declare per device."""
+    return _cached(
+        mesh, "rows", ndim, P(mesh.axis_names, *([None] * (ndim - 1)))
+    )
+
+
 def replicated(mesh: Mesh, ndim: int = 0) -> NamedSharding:
     return _cached(mesh, "replicated", ndim, P())
+
+
+#: declared role name -> spec builder — the introspectable export graftspmd
+#: (``lint/spmd.py``) cross-references: a registered core's ``arg_roles``
+#: name these roles, and the S2 contract check compares each role's
+#: NamedSharding against the actual ``mhlo.sharding`` annotation on the
+#: lowered module's parameters. Adding a role here is what makes it
+#: declarable; a spec spelled anywhere else is a graftlint R12 violation.
+ROLE_BUILDERS = {
+    "chain_batch": chain_batch,
+    "portfolio": portfolio,
+    "chain_rows": chain_rows,
+    "bucket": bucket,
+    "rows": rows,
+    "replicated": replicated,
+}
+
+
+def role_sharding(mesh: Mesh, role: str, ndim: int) -> NamedSharding:
+    """The declared NamedSharding for ``role`` at ``ndim`` — the single
+    lookup point for graftspmd's contract checks and the spmd builders."""
+    if role == "portfolio":
+        return portfolio(mesh)
+    return ROLE_BUILDERS[role](mesh, ndim)
 
 
 def _placed_like(x, sharding: NamedSharding) -> bool:

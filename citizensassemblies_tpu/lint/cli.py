@@ -5,8 +5,14 @@ package that contains this module is linted. ``--ir`` switches to the
 jaxpr/HLO-level verifier (``lint.ir``): every registered hot core is traced
 and checked for callbacks, f64 leaks, dropped donations and cost-budget
 regressions against ``ANALYSIS_BUDGET.json`` (``--update-budget`` re-ratchets
-the file deliberately). ``--format json`` emits the stable machine schema for
-either pass.
+the file deliberately). ``--spmd`` runs the third pass (``lint.spmd``):
+every registered core is AOT-compiled — the mesh-consuming ones under
+1/2/4/8-device virtual meshes — and checked for collective-census
+regressions against ``SPMD_BUDGET.json`` (``--update-spmd-budget``
+re-ratchets), sharding-contract violations, and precision-flow isolation
+(``--precision-out`` writes ``PRECISION_FLOW.json``). ``--format json``
+emits the stable machine schema for any pass — the three passes share the
+``{"schema_version", "pass", "ok", ..., "violations": [...]}`` envelope.
 """
 
 from __future__ import annotations
@@ -14,6 +20,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 from pathlib import Path
 from typing import Optional, Sequence
@@ -22,13 +29,31 @@ from citizensassemblies_tpu.lint.engine import lint_paths, render_report
 
 
 def _ast_report_as_json(report) -> dict:
-    """Stable schema shared with the IR pass: rule, path, line, message."""
+    """Stable schema shared with the IR and SPMD passes: rule, path, line,
+    message inside the common pass envelope."""
     return {
+        "schema_version": 1,
+        "pass": "ast",
         "ok": report.ok,
         "files": report.files,
         "suppressed": report.suppressed,
         "violations": [dataclasses.asdict(v) for v in report.violations],
     }
+
+
+def _bootstrap_virtual_devices() -> None:
+    """Give the SPMD sweep its 8 virtual CPU devices when jax has not been
+    imported yet — exactly what ``tests/conftest.py`` and the Makefile
+    targets do; a late call (jax already up) leaves the environment alone
+    and the sweep verifies whatever sizes the backend exposes."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -76,13 +101,72 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     parser.add_argument(
         "--diff-out", type=Path, default=None,
-        help="with --ir: write the measured-vs-budget diff JSON here "
+        help="with --ir/--spmd: write the measured-vs-budget diff JSON here "
         "(the CI build artifact)",
+    )
+    parser.add_argument(
+        "--spmd", action="store_true",
+        help="run the SPMD verifier (collective census vs SPMD_BUDGET.json, "
+        "sharding contracts, precision flow) over the registered cores — "
+        "mesh-consuming cores swept across 1/2/4/8 virtual devices",
+    )
+    parser.add_argument(
+        "--spmd-budget", type=Path, default=None,
+        help="collective-census budget file for --spmd (default: "
+        "SPMD_BUDGET.json at the repo root)",
+    )
+    parser.add_argument(
+        "--update-spmd-budget", action="store_true",
+        help="with --spmd: re-measure every core's collective census and "
+        "REWRITE the budget file (the deliberate ratchet move); S2/S3 "
+        "still fail",
+    )
+    parser.add_argument(
+        "--precision-out", type=Path, default=None,
+        help="with --spmd: write the S3 precision-flow artifact here "
+        "(PRECISION_FLOW.json in CI)",
     )
     args = parser.parse_args(argv)
 
     if args.update_budget and not args.ir:
         parser.error("--update-budget requires --ir")
+    if args.update_spmd_budget and not args.spmd:
+        parser.error("--update-spmd-budget requires --spmd")
+    if args.ir and args.spmd:
+        parser.error("--ir and --spmd are separate passes; run them "
+                     "separately")
+    if args.spmd:
+        if args.paths:
+            parser.error("--spmd verifies the registered cores; paths are "
+                         "for the AST pass")
+        _bootstrap_virtual_devices()
+        from citizensassemblies_tpu.lint.spmd import (
+            render_spmd_report,
+            run_spmd_checks,
+            spmd_budget_diff,
+            spmd_report_as_json,
+        )
+
+        report = run_spmd_checks(
+            budget_path=args.spmd_budget,
+            update_budget=args.update_spmd_budget,
+            precision_out=args.precision_out,
+        )
+        if args.diff_out is not None:
+            args.diff_out.write_text(
+                json.dumps(spmd_budget_diff(report), indent=1, sort_keys=True)
+                + "\n",
+                encoding="utf-8",
+            )
+        if args.format == "json":
+            print(json.dumps(spmd_report_as_json(report), indent=1))
+        else:
+            rendered = render_spmd_report(report)
+            if args.quiet:
+                rendered = "\n".join(v.render() for v in report.violations)
+            if rendered:
+                print(rendered)
+        return 0 if report.ok else 1
     if args.ir:
         if args.paths:
             parser.error("--ir verifies the registered cores; paths are "

@@ -174,7 +174,7 @@ def iter_python_files(paths: Iterable[Path]) -> List[Path]:
 
 
 def all_rules():
-    """The registered rule set, R1..R11 (R0 is emitted by the engine itself)."""
+    """The registered rule set, R1..R12 (R0 is emitted by the engine itself)."""
     from citizensassemblies_tpu.lint.config_rule import ConfigKnobRule
     from citizensassemblies_tpu.lint.rules import (
         CoreSpanRule,
@@ -185,6 +185,7 @@ def all_rules():
         JitConstructionRule,
         MeshHygieneRule,
         MetricHygieneRule,
+        ShardingSpecHygieneRule,
         ThreadDisciplineRule,
         TracerBranchRule,
     )
@@ -201,6 +202,7 @@ def all_rules():
         FaultSiteRule(),
         MeshHygieneRule(),
         MetricHygieneRule(),
+        ShardingSpecHygieneRule(),
     ]
 
 

@@ -537,6 +537,8 @@ def render_ir_report(report: IRReport) -> str:
 def ir_report_as_json(report: IRReport) -> Dict[str, Any]:
     """Stable JSON schema shared with the AST linter's ``--format json``."""
     return {
+        "schema_version": 1,
+        "pass": "ir",
         "ok": report.ok,
         "budget": report.budget_path,
         "tolerance": report.tolerance,

@@ -43,6 +43,15 @@ class IRCase:
     inverts and flags f64→f32 ``convert_element_type`` narrowing instead.
     ``x64_trace=False`` skips the enable-x64 dtype trace for kernels whose
     tracing is dtype-pinned some other way.
+
+    ``arg_roles`` is the graftspmd S2 sharding contract: one
+    ``dist/partition.py`` role name (a ``ROLE_BUILDERS`` key) per argument,
+    or ``None`` for an argument the builder left undeclared. The spmd pass
+    attaches each declared role's NamedSharding to the example aval before
+    lowering and cross-references the ``mhlo.sharding`` annotations the
+    compiler actually emits; an *undeclared* operand above the
+    ``spmd_replicated_bytes_max`` threshold is flagged as an implicitly
+    replicated mega-operand.
     """
 
     fn: Any
@@ -51,6 +60,7 @@ class IRCase:
     donate_expected: int = 0
     allow_f64: bool = False
     x64_trace: bool = True
+    arg_roles: Optional[Tuple[Optional[str], ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,8 +90,33 @@ class CoreEntry:
     span_optout: Optional[str] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class SpmdEntry:
+    """One mesh-consuming core's SPMD registration (graftspmd, ``lint/spmd.py``).
+
+    ``build`` takes the virtual mesh the verifier is sweeping (1/2/4/8
+    devices) and returns the :class:`IRCase` for THAT mesh — normally by
+    calling the same memoized mesh-keyed factory the production path uses,
+    with ``arg_roles`` naming the declared ``dist/partition.py`` layout of
+    every argument. ``loop_collectives`` is the reasoned exemption from the
+    S2 no-collective-inside-a-while-body check, for cores whose per-iteration
+    communication is algorithmically required (the row-sharded GEMV
+    reduction); without it, a collective reachable from a ``while`` body is
+    a named FAIL.
+    """
+
+    name: str
+    path: str
+    line: int
+    build: Callable[[Any], IRCase]  # mesh -> IRCase
+    loop_collectives: Optional[str] = None
+
+
 #: name -> entry, populated by importing the MANIFEST modules
 _REGISTRY: Dict[str, CoreEntry] = {}
+
+#: name -> mesh-parameterized SPMD registration (a subset of _REGISTRY names)
+_SPMD_REGISTRY: Dict[str, SpmdEntry] = {}
 
 #: every module that registers at least one core. ``collect()`` imports
 #: these; keep the list sorted by package path so reports are deterministic.
@@ -145,6 +180,33 @@ def register_ir_core(
     return deco
 
 
+def register_spmd_core(
+    name: str,
+    loop_collectives: Optional[str] = None,
+) -> Callable:
+    """Decorator: register ``build(mesh)`` as the SPMD builder for ``name``.
+
+    The decorated function takes the virtual mesh graftspmd is sweeping and
+    returns an :class:`IRCase` whose ``arg_roles`` declare each argument's
+    ``dist/partition.py`` layout. ``loop_collectives`` is the reasoned
+    exemption from the mid-loop-collective check (see :class:`SpmdEntry`);
+    leave it ``None`` unless per-iteration communication is the algorithm.
+    """
+
+    def deco(build: Callable[[Any], IRCase]) -> Callable[[Any], IRCase]:
+        src = inspect.getsourcefile(build) or "<unknown>"
+        _SPMD_REGISTRY[name] = SpmdEntry(
+            name=name,
+            path=_rel_path(src),
+            line=build.__code__.co_firstlineno,
+            build=build,
+            loop_collectives=loop_collectives,
+        )
+        return build
+
+    return deco
+
+
 def sparse_pairs() -> Dict[str, str]:
     """``{ell core name: dense twin name}`` for every registered pair —
     the budget-diff artifact's dense→sparse delta table keys off this."""
@@ -164,3 +226,12 @@ def collect() -> List[CoreEntry]:
     return [
         _REGISTRY[name] for name in sorted(_REGISTRY)
     ]
+
+
+def collect_spmd() -> List[SpmdEntry]:
+    """Import every MANIFEST module and return the mesh-parameterized SPMD
+    registrations, sorted — the cores graftspmd sweeps across virtual mesh
+    sizes (every other registered core is censused at its default build)."""
+    for mod in MANIFEST:
+        importlib.import_module(mod)
+    return [_SPMD_REGISTRY[name] for name in sorted(_SPMD_REGISTRY)]

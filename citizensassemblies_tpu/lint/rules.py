@@ -1582,3 +1582,170 @@ class MetricHygieneRule:
                             "(with a help line) before emitting it"
                         )
         return out
+
+
+class ShardingSpecHygieneRule:
+    """R12 — sharding specs are declared once, in ``dist/partition.py``.
+
+    graftspmd's S2 contract check can only cross-reference layouts that are
+    *declared* — a ``NamedSharding`` spelled inline at a call site is
+    invisible to it, and historically that is exactly where the
+    ``dist_reshards`` bugs came from: two stages each hand-rolling "the"
+    spec, drifting apart by one ``None``. Two findings:
+
+    * **Inline spec constructions.** ``NamedSharding(...)`` anywhere outside
+      the partition module is a violation — call a ``ROLE_BUILDERS`` role
+      (or add one) instead. ``PartitionSpec``/``P`` constructions are legal
+      only inside functions that build a mesh closure
+      (``shard_map``/``shard_map_compat``/``pjit``): there they are the
+      per-device block specs of the closure itself, not a placement
+      contract. Factories that return the constructed spec and functions
+      with a mesh-keyed memo store are exempt, same judgement as R2/R10.
+
+    * **Unknown collective axis literals.** R10 flags *known* axis names
+      spelled as literals; this rule closes the complement — a string
+      literal axis argument to a collective that is NOT one of the topology
+      module's ``AXIS_*`` names is either a typo or an undeclared axis,
+      and fails on the biggest mesh first. Names, attributes and parameters
+      pass: only literals are claimed.
+
+    Test modules are exempt (fixtures construct ad-hoc specs on purpose).
+    """
+
+    rule_id = "R12"
+    name = "sharding-spec-hygiene"
+    description = "inline NamedSharding/PartitionSpec constructions, unknown collective axis literals"
+
+    #: the spec definition site (constructions are legal only here)
+    _PARTITION_SUFFIX = "dist/partition.py"
+    _SPEC_NAMES = frozenset({"NamedSharding", "PartitionSpec"})
+    _COLLECTIVE_SUFFIXES = frozenset({
+        "psum", "pmax", "pmin", "pmean", "pall", "pany",
+        "all_gather", "all_to_all", "ppermute", "axis_index", "psum_scatter",
+    })
+
+    @classmethod
+    def _is_partition(cls, mod: ModuleSource) -> bool:
+        return str(mod.path).replace("\\", "/").endswith(cls._PARTITION_SUFFIX)
+
+    @staticmethod
+    def _skip_module(mod: ModuleSource) -> bool:
+        name = mod.path.name
+        return (
+            "tests" in mod.path.parts
+            or name.startswith("test_")
+            or name == "conftest.py"
+        )
+
+    @classmethod
+    def _spec_aliases(cls, tree: ast.Module) -> Set[str]:
+        """Local names bound to jax.sharding spec constructors — only these
+        are claimed, so an unrelated local ``P`` helper never trips."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "jax.sharding":
+                for alias in node.names:
+                    if alias.name in cls._SPEC_NAMES:
+                        out.add(alias.asname or alias.name)
+        return out
+
+    @classmethod
+    def _builds_mesh_closure(cls, fn: ast.AST) -> bool:
+        """Does ``fn`` reference a shard_map/pjit builder anywhere — called
+        directly OR handed to ``functools.partial`` as a decorator?"""
+        suffixes = MeshHygieneRule._MESH_CLOSURE_SUFFIXES
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id in suffixes:
+                return True
+            if isinstance(node, ast.Attribute) and node.attr in suffixes:
+                return True
+        return False
+
+    def check_package(
+        self, modules: Sequence[ModuleSource], readme=None
+    ) -> List[Violation]:
+        axes = MeshHygieneRule._axis_names(modules)
+        out: List[Violation] = []
+        for mod in modules:
+            if self._is_partition(mod) or self._skip_module(mod):
+                continue
+            out.extend(self._check_spec_constructions(mod))
+            out.extend(self._check_axis_literals(mod, axes))
+        return out
+
+    def _check_spec_constructions(self, mod: ModuleSource) -> List[Violation]:
+        aliases = self._spec_aliases(mod.tree)
+        parents = parent_map(mod.tree)
+        module_names = MeshHygieneRule._module_container_names(mod.tree)
+        out: List[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None:
+                continue
+            last = d.rsplit(".", 1)[-1]
+            is_named = last == "NamedSharding" and (
+                last in aliases or d.endswith("sharding.NamedSharding")
+            )
+            is_pspec = (last in aliases and last != "NamedSharding") or (
+                d.endswith("sharding.PartitionSpec")
+            )
+            if not (is_named or is_pspec):
+                continue
+            fn = enclosing(node, parents, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if fn is not None:
+                if is_pspec and self._builds_mesh_closure(fn):
+                    continue  # per-device block specs of the closure itself
+                if MeshHygieneRule._has_mesh_keyed_memo(fn, module_names):
+                    continue
+                if MeshHygieneRule._is_factory(fn, node, parents):
+                    continue
+            kind = "NamedSharding" if is_named else "PartitionSpec"
+            out.append(
+                Violation(
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    rule=self.rule_id, name=self.name,
+                    message=(
+                        f"inline {kind} construction outside the partition "
+                        "module — declare the layout as a dist/partition.py "
+                        "role (ROLE_BUILDERS) so graftspmd can verify the "
+                        "contract; ad-hoc specs are where dist_reshards "
+                        "come from"
+                    ),
+                )
+            )
+        return out
+
+    def _check_axis_literals(
+        self, mod: ModuleSource, axes: Set[str]
+    ) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None or d.rsplit(".", 1)[-1] not in self._COLLECTIVE_SUFFIXES:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for c in ast.walk(arg):
+                    if (
+                        isinstance(c, ast.Constant)
+                        and isinstance(c.value, str)
+                        and c.value not in axes
+                    ):
+                        out.append(
+                            Violation(
+                                path=mod.rel, line=c.lineno, col=c.col_offset,
+                                rule=self.rule_id, name=self.name,
+                                message=(
+                                    f"collective axis literal '{c.value}' is "
+                                    "not an AXIS_* name from the graftpod "
+                                    "topology module — a typo'd or "
+                                    "undeclared axis fails at runtime on the "
+                                    "biggest mesh; use the dist.runtime "
+                                    "constants"
+                                ),
+                            )
+                        )
+        return out

@@ -1,0 +1,829 @@
+"""graftspmd: static SPMD verification — collective census, sharding
+contracts, and precision flow.
+
+graftcheck-IR (``lint/ir.py``) sees the single-device jaxpr; this pass sees
+what the SPMD partitioner builds. The reshard bug class it exists to catch:
+one careless ``jnp`` op in a sharded core makes XLA insert an all-gather
+that costs nothing on the 1-device CI host and everything on an 8-host mesh
+— today observed only after the fact by the ``dist_reshards`` runtime gauge.
+Every registered core is AOT-compiled (``fn.lower(...).compile()``), the
+mesh-consuming cores additionally under 1/2/4/8-device virtual meshes
+(``--xla_force_host_platform_device_count``), and three check families run
+over the result:
+
+* **S1 collective census** — ``all-gather`` / ``all-reduce`` /
+  ``reduce-scatter`` / ``all-to-all`` / ``collective-permute`` instruction
+  counts per core per mesh size, ratcheted against the committed
+  ``SPMD_BUDGET.json`` exactly like IR4: a new collective kind or a count
+  increase in any core is a named FAIL; ``--update-spmd-budget``
+  regenerates the file deliberately. The census runs on *compiled* HLO —
+  partitioner-inserted collectives (the silent-reshard class) never appear
+  in the pre-SPMD StableHLO.
+* **S2 sharding contracts** — each SPMD registration declares its
+  arguments' ``dist/partition.py`` roles (``IRCase.arg_roles``); the pass
+  attaches the declared NamedShardings, lowers, and cross-references the
+  ``mhlo.sharding`` annotations the compiler actually placed on the main
+  parameters. It also flags *undeclared* (implicitly replicated) operands
+  above ``Config.spmd_replicated_bytes_max``, and any collective reachable
+  from a ``while``-loop body — per-iteration comms; the PDHG cores keep
+  collectives at check-every boundaries — unless the registration carries
+  a reasoned ``loop_collectives`` exemption (the row-sharded GEMV's psum
+  is the algorithm, not a regression).
+* **S3 precision flow** — dtype propagation through each core's jaxpr,
+  classifying every intermediate as ``bf16_safe`` / ``f32_pinned`` /
+  ``f64_certification`` (the IR2 cert-tagged cores are the f64 sinks) into
+  ``PRECISION_FLOW.json`` — the prerequisite artifact for the
+  mixed-precision PDHG (ROADMAP item 5). The classification is per scope:
+  comparison/callback consumers, scope outputs and anything feeding an
+  f64-producing equation are pinned, so no ``bf16_safe`` value can touch a
+  certification path (``cert_isolated``, verified per core).
+
+Run as ``python -m citizensassemblies_tpu.lint --spmd`` (or ``make
+check-spmd``); reports use graftlint's ``file:line`` contract, pointing at
+each core's registration site.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from citizensassemblies_tpu.lint.engine import Violation
+from citizensassemblies_tpu.lint.ir import _trace_jaxpr
+from citizensassemblies_tpu.lint.registry import (
+    CoreEntry,
+    IRCase,
+    SpmdEntry,
+    collect,
+    collect_spmd,
+)
+
+#: the committed collective-census budget, at the repo root next to the
+#: package (same placement as ANALYSIS_BUDGET.json)
+SPMD_BUDGET_PATH = (
+    Path(__file__).resolve().parent.parent.parent / "SPMD_BUDGET.json"
+)
+
+#: the committed precision-flow artifact (S3)
+PRECISION_FLOW_PATH = (
+    Path(__file__).resolve().parent.parent.parent / "PRECISION_FLOW.json"
+)
+
+#: the virtual mesh sizes the SPMD registrations are swept across
+MESH_SIZES = (1, 2, 4, 8)
+
+#: compiled-HLO collective opcodes (S1). ``-start``/``-done`` async pairs
+#: count once, via the start.
+COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+_COLL_RE = re.compile(
+    r"(?<![%\w-])(" + "|".join(COLLECTIVE_OPS) + r")(-start)?\("
+)
+_HDR_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\{\s*$")
+_CALL_REF_RE = re.compile(
+    r"(?:to_apply|calls|condition|body|branch_computations)="
+    r"(?:%([\w.\-]+)|\{([^}]*)\})"
+)
+_WHILE_RE = re.compile(r"(?<![%\w-])while\(")
+# the attribute dict can nest braces inside quoted strings (the
+# mhlo.sharding value itself is "{devices=[2,1]<=[2]}"), so the dict match
+# must treat quoted spans as opaque
+_ARG_RE = re.compile(r"%arg(\d+): tensor<[^>]*>\s*(\{(?:[^{}\"]|\"[^\"]*\")*\})?")
+_MHLO_SHARDING_RE = re.compile(r'mhlo\.sharding = "([^"]+)"')
+
+#: jaxpr consumers that pin their float operands at f32 (S3): comparisons
+#: (convergence/feasibility tests — the 1e-3 contract is decided here),
+#: host callbacks, and value-ordering primitives whose ties flip under
+#: narrowing
+_PIN_PRIMS = frozenset(
+    {
+        "lt", "le", "gt", "ge", "eq", "ne",
+        "sort", "argmax", "argmin", "reduce_max", "reduce_min",
+        "pure_callback", "io_callback", "debug_callback", "callback",
+        "custom_call",
+    }
+)
+
+
+# --- compiled-HLO parsing (S1 + the mid-loop check) --------------------------
+
+
+def _parse_hlo(text: str):
+    """``(computations, whiles)`` from compiled-HLO text: per computation
+    the collective opcodes it contains and the computations it references;
+    plus every ``while`` instruction's (condition, body) computation names."""
+    comps: Dict[str, Dict[str, Any]] = {}
+    whiles: List[Tuple[Optional[str], Optional[str]]] = []
+    cur: Optional[str] = None
+    for line in text.splitlines():
+        m = _HDR_RE.match(line)
+        if m:
+            cur = m.group(1)
+            comps[cur] = {"colls": [], "calls": set()}
+            continue
+        if cur is None:
+            continue
+        for cm in _COLL_RE.finditer(line):
+            comps[cur]["colls"].append(cm.group(1))
+        for rm in _CALL_REF_RE.finditer(line):
+            if rm.group(1):
+                comps[cur]["calls"].add(rm.group(1))
+            else:
+                comps[cur]["calls"].update(
+                    t.strip().lstrip("%") for t in rm.group(2).split(",") if t.strip()
+                )
+        if _WHILE_RE.search(line):
+            c = re.search(r"condition=%?([\w.\-]+)", line)
+            b = re.search(r"body=%?([\w.\-]+)", line)
+            whiles.append((c.group(1) if c else None, b.group(1) if b else None))
+    return comps, whiles
+
+
+def collective_census(hlo_text: str) -> Dict[str, int]:
+    """S1: ``{collective opcode: instruction count}`` over a compiled module."""
+    census: Dict[str, int] = {}
+    comps, _ = _parse_hlo(hlo_text)
+    for comp in comps.values():
+        for op in comp["colls"]:
+            census[op] = census.get(op, 0) + 1
+    return census
+
+
+def loop_collectives(hlo_text: str) -> List[str]:
+    """Collective opcodes transitively reachable from any ``while`` BODY
+    computation — per-iteration communication. Condition computations are
+    deliberately out of scope: a convergence all-reduce at the check-every
+    boundary is the contract, not a violation."""
+    comps, whiles = _parse_hlo(hlo_text)
+
+    def reach(start: Optional[str]):
+        seen: set = set()
+        stack = [start] if start else []
+        while stack:
+            name = stack.pop()
+            if name in seen or name not in comps:
+                continue
+            seen.add(name)
+            stack.extend(comps[name]["calls"])
+        return seen
+
+    found: set = set()
+    for _cond, body in whiles:
+        for comp in reach(body):
+            found.update(comps[comp]["colls"])
+    return sorted(found)
+
+
+# --- lowered-StableHLO parameter shardings (S2) ------------------------------
+
+
+def param_shardings(stablehlo_text: str) -> List[Optional[str]]:
+    """Per-parameter ``mhlo.sharding`` annotation of the ``@main`` entry
+    function, ``None`` for an unannotated (implicitly replicated) one."""
+    start = stablehlo_text.find("@main(")
+    if start < 0:
+        return []
+    # the signature normally prints on one line; accumulate until the body
+    # opens in case a formatter ever wraps it
+    sig_lines: List[str] = []
+    for line in stablehlo_text[start:].splitlines():
+        sig_lines.append(line)
+        if line.rstrip().endswith("{"):
+            break
+    sig = " ".join(sig_lines)
+    out: List[Optional[str]] = []
+    for m in _ARG_RE.finditer(sig):
+        idx, attrs = int(m.group(1)), m.group(2) or ""
+        sh = _MHLO_SHARDING_RE.search(attrs)
+        while len(out) <= idx:
+            out.append(None)
+        out[idx] = sh.group(1) if sh else None
+    return out
+
+
+def _expected_annotation(sharding, ndim: int) -> Optional[str]:
+    """The mhlo.sharding string a declared NamedSharding should lower to."""
+    try:
+        hlo = sharding._to_xla_hlo_sharding(ndim)
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+    return str(hlo)
+
+
+# --- S3 precision flow -------------------------------------------------------
+
+
+def _all_jaxprs(jaxpr):
+    """``jaxpr`` and every sub-jaxpr (scan/while/cond/pjit bodies)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for value in eqn.params.values():
+            items = value if isinstance(value, (list, tuple)) else [value]
+            for item in items:
+                sub = getattr(item, "jaxpr", item if hasattr(item, "eqns") else None)
+                if sub is not None:
+                    yield from _all_jaxprs(sub)
+
+
+def precision_flow(jaxpr) -> Dict[str, Any]:
+    """Classify every intermediate value of ``jaxpr`` (recursively, per
+    scope) as ``bf16_safe`` / ``f32_pinned`` / ``f64_certification`` /
+    ``non_float``.
+
+    A float32/bfloat16 value is *pinned* when a comparison, sort/extremum,
+    callback or custom call consumes it, when it is a scope output, or when
+    it feeds an equation producing (or converting to) strong float64 — so by
+    construction no ``bf16_safe`` value is an operand of the certification
+    arithmetic. ``cert_isolated`` re-verifies that invariant explicitly.
+    """
+    counts = {"bf16_safe": 0, "f32_pinned": 0, "f64_certification": 0, "non_float": 0}
+    cert_isolated = True
+    classes: Dict[Any, str] = {}
+    for sub in _all_jaxprs(jaxpr):
+        outvars = {v for v in sub.outvars if hasattr(v, "aval")}
+        consumers: Dict[Any, List[Any]] = {}
+        for eqn in sub.eqns:
+            for var in eqn.invars:
+                if hasattr(var, "aval") and not hasattr(var, "val"):
+                    consumers.setdefault(var, []).append(eqn)
+        for eqn in sub.eqns:
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                dtype = str(getattr(aval, "dtype", ""))
+                if not dtype.startswith(("float", "bfloat")):
+                    cls = "non_float"
+                elif dtype == "float64" and not getattr(aval, "weak_type", False):
+                    cls = "f64_certification"
+                else:
+                    cls = "bf16_safe"
+                    if var in outvars:
+                        cls = "f32_pinned"
+                    for consumer in consumers.get(var, []):
+                        if consumer.primitive.name in _PIN_PRIMS:
+                            cls = "f32_pinned"
+                            break
+                        feeds_f64 = any(
+                            str(getattr(o.aval, "dtype", "")) == "float64"
+                            and not getattr(o.aval, "weak_type", False)
+                            for o in consumer.outvars
+                            if hasattr(o, "aval")
+                        )
+                        if feeds_f64:
+                            cls = "f32_pinned"
+                            break
+                counts[cls] += 1
+                classes[var] = cls
+        # the explicit invariant: no bf16-safe value is a direct operand of
+        # an f64-producing equation in its scope
+        for eqn in sub.eqns:
+            produces_f64 = any(
+                str(getattr(o.aval, "dtype", "")) == "float64"
+                and not getattr(o.aval, "weak_type", False)
+                for o in eqn.outvars
+                if hasattr(o, "aval")
+            )
+            if not produces_f64:
+                continue
+            for var in eqn.invars:
+                if classes.get(var) == "bf16_safe":
+                    cert_isolated = False
+    total = sum(counts.values())
+    return {**counts, "total": total, "cert_isolated": cert_isolated}
+
+
+# --- per-core verification ---------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpmdCoreReport:
+    """Verification outcome for one registered core across its builds."""
+
+    name: str
+    path: str
+    line: int
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+    #: {"base": {op: n}, "mesh1": {op: n}, ...} — the measured S1 census
+    census: Optional[Dict[str, Dict[str, int]]] = None
+    #: the S3 classification of the base build
+    precision: Optional[Dict[str, Any]] = None
+    #: reasoned mid-loop-collective exemption, when registered
+    loop_exempt: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+@dataclasses.dataclass
+class SpmdReport:
+    """The whole pass: per-core reports plus budget bookkeeping."""
+
+    cores: List[SpmdCoreReport]
+    budget_path: str
+    mesh_sizes: List[int]
+    updated: bool = False
+
+    @property
+    def violations(self) -> List[Violation]:
+        return [v for c in self.cores for v in c.violations]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def _viol(entry, rule: str, name: str, message: str) -> Violation:
+    return Violation(
+        path=entry.path, line=entry.line, col=0, rule=rule, name=name,
+        message=f"[{entry.name}] {message}",
+    )
+
+
+def _replicated_bytes_max() -> int:
+    from citizensassemblies_tpu.utils.config import default_config
+
+    return int(default_config().spmd_replicated_bytes_max)
+
+
+def _aval_bytes(a) -> int:
+    import numpy as np
+
+    shape = getattr(a, "shape", ())
+    dtype = getattr(a, "dtype", None)
+    if dtype is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64) * np.dtype(dtype).itemsize) if shape else int(np.dtype(dtype).itemsize)
+
+
+def _sharded_args(case: IRCase, mesh):
+    """The example avals with each declared role's NamedSharding attached
+    (undeclared arguments stay as built — implicitly replicated)."""
+    import jax
+
+    from citizensassemblies_tpu.dist import partition as dist_partition
+
+    roles = case.arg_roles or (None,) * len(case.args)
+    out = []
+    for a, role in zip(case.args, roles):
+        if role is None:
+            out.append(a)
+            continue
+        sharding = dist_partition.role_sharding(mesh, role, len(a.shape))
+        out.append(jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding))
+    return tuple(out)
+
+
+def _lower(case: IRCase, args):
+    return case.fn.lower(*args, **case.static)
+
+
+def _census_one(
+    entry,
+    report: SpmdCoreReport,
+    case: IRCase,
+    args,
+    size_key: str,
+    exempt: Optional[str],
+) -> Optional[Dict[str, int]]:
+    """Compile one build, record its census, run the mid-loop check."""
+    try:
+        hlo = _lower(case, args).compile().as_text()
+    except Exception as exc:  # noqa: BLE001 - reported, not raised
+        report.violations.append(
+            _viol(
+                entry, "S0", "uncompilable-core",
+                f"lower/compile failed at {size_key}: {exc!r}",
+            )
+        )
+        return None
+    census = collective_census(hlo)
+    in_loop = loop_collectives(hlo)
+    if in_loop and exempt is None:
+        report.violations.append(
+            _viol(
+                entry, "S2", "collective-in-loop-body",
+                f"collective(s) {', '.join(in_loop)} reachable from a "
+                f"while-loop body at {size_key} — per-iteration communication; "
+                "keep collectives at check-every boundaries, or register the "
+                "core with a reasoned loop_collectives= exemption if the "
+                "per-iteration reduction IS the algorithm",
+            )
+        )
+    return census
+
+
+def _check_contract(entry, report: SpmdCoreReport, case: IRCase, mesh, size_key: str):
+    """S2: declared roles vs actual mhlo.sharding annotations, plus the
+    implicitly-replicated mega-operand check."""
+    from citizensassemblies_tpu.dist import partition as dist_partition
+
+    args = _sharded_args(case, mesh)
+    try:
+        text = _lower(case, args).as_text()
+    except Exception as exc:  # noqa: BLE001
+        report.violations.append(
+            _viol(
+                entry, "S0", "unlowerable-core",
+                f"lower failed at {size_key}: {exc!r}",
+            )
+        )
+        return
+    actual = param_shardings(text)
+    roles = case.arg_roles or (None,) * len(case.args)
+    threshold = _replicated_bytes_max()
+    n_devices = int(mesh.devices.size)
+    for i, (a, role) in enumerate(zip(case.args, roles)):
+        got = actual[i] if i < len(actual) else None
+        if role is None:
+            if n_devices > 1 and _aval_bytes(a) > threshold:
+                report.violations.append(
+                    _viol(
+                        entry, "S2", "implicit-replication",
+                        f"argument {i} ({_aval_bytes(a)} bytes) has no "
+                        f"declared dist/partition.py role at {size_key} — "
+                        "implicitly replicated on every device; declare its "
+                        "role in arg_roles ('replicated' if that IS the "
+                        "layout) or shard it",
+                    )
+                )
+            continue
+        if n_devices == 1:
+            # every layout over one device is the same layout; XLA
+            # canonicalizes them all to "{maximal device=0}"
+            continue
+        expected = _expected_annotation(
+            dist_partition.role_sharding(mesh, role, len(a.shape)), len(a.shape)
+        )
+        if expected is None:
+            continue  # jax internals unavailable — contract not checkable
+        if got is None and expected == "{replicated}":
+            continue  # unannotated == replicated
+        if got != expected:
+            report.violations.append(
+                _viol(
+                    entry, "S2", "sharding-contract-mismatch",
+                    f"argument {i} declared role '{role}' lowers to "
+                    f"{got or '<unannotated>'} instead of {expected} at "
+                    f"{size_key} — the declared dist/partition.py spec and "
+                    "the compiled layout disagree",
+                )
+            )
+
+
+def verify_spmd_core(
+    entry: CoreEntry,
+    spmd_entry: Optional[SpmdEntry],
+    budget: Optional[Dict[str, Dict[str, int]]],
+    mesh_sizes: Sequence[int],
+) -> SpmdCoreReport:
+    """Run S1–S3 for one registered core; check failures become violations,
+    never exceptions (a core that no longer builds is reported too)."""
+    report = SpmdCoreReport(name=entry.name, path=entry.path, line=entry.line)
+    report.loop_exempt = spmd_entry.loop_collectives if spmd_entry else None
+    try:
+        base_case = entry.build()
+    except Exception as exc:  # noqa: BLE001
+        report.violations.append(
+            _viol(entry, "S0", "untraceable-core", f"builder failed: {exc!r}")
+        )
+        return report
+
+    measured: Dict[str, Dict[str, int]] = {}
+    base_census = _census_one(
+        entry, report, base_case, base_case.args, "base", report.loop_exempt
+    )
+    if base_census is not None:
+        measured["base"] = base_census
+
+    # --- S3: precision flow of the base build ------------------------------
+    try:
+        closed = _trace_jaxpr(
+            base_case, x64=base_case.allow_f64 and base_case.x64_trace
+        )
+        report.precision = precision_flow(closed.jaxpr)
+        report.precision["cert_sink"] = bool(base_case.allow_f64)
+        if not report.precision["cert_isolated"]:
+            report.violations.append(
+                _viol(
+                    entry, "S3", "bf16-unsafe-cert-contact",
+                    "a bf16-safe intermediate is a direct operand of the "
+                    "float64 certification arithmetic — the precision-flow "
+                    "classification must pin every value feeding an f64 sink",
+                )
+            )
+    except Exception as exc:  # noqa: BLE001
+        report.violations.append(
+            _viol(entry, "S0", "untraceable-core", f"precision trace failed: {exc!r}")
+        )
+
+    # --- the virtual-mesh sweep (SPMD registrations only) ------------------
+    if spmd_entry is not None:
+        from citizensassemblies_tpu.dist.runtime import topology_mesh
+
+        for size in mesh_sizes:
+            key = f"mesh{size}"
+            mesh = topology_mesh(size)
+            try:
+                case = spmd_entry.build(mesh)
+            except Exception as exc:  # noqa: BLE001
+                report.violations.append(
+                    _viol(
+                        entry, "S0", "untraceable-core",
+                        f"spmd builder failed at {key}: {exc!r}",
+                    )
+                )
+                continue
+            args = _sharded_args(case, mesh)
+            census = _census_one(entry, report, case, args, key, report.loop_exempt)
+            if census is not None:
+                measured[key] = census
+            _check_contract(entry, report, case, mesh, key)
+
+    report.census = measured
+
+    # --- S1: the ratchet ----------------------------------------------------
+    if budget is None:
+        report.violations.append(
+            _viol(
+                entry, "S1", "missing-budget",
+                "no entry in the SPMD budget — run 'python -m "
+                "citizensassemblies_tpu.lint --spmd --update-spmd-budget' "
+                "and commit the result",
+            )
+        )
+        return report
+    for size_key, census in sorted(measured.items()):
+        allowed = budget.get(size_key)
+        if allowed is None:
+            report.violations.append(
+                _viol(
+                    entry, "S1", "missing-budget",
+                    f"no budgeted census for {size_key} — re-ratchet with "
+                    "--update-spmd-budget",
+                )
+            )
+            continue
+        for op, count in sorted(census.items()):
+            if op not in allowed:
+                report.violations.append(
+                    _viol(
+                        entry, "S1", "new-collective",
+                        f"collective '{op}' ({count}x) at {size_key} is new "
+                        "to this core — the silent-reshard class; find the "
+                        "op that introduced it, or re-ratchet with "
+                        "--update-spmd-budget if the communication is "
+                        "deliberate",
+                    )
+                )
+            elif count > int(allowed[op]):
+                report.violations.append(
+                    _viol(
+                        entry, "S1", "collective-count-exceeded",
+                        f"collective '{op}' count regressed at {size_key}: "
+                        f"{count} > budgeted {allowed[op]} — re-ratchet with "
+                        "--update-spmd-budget if intentional",
+                    )
+                )
+    return report
+
+
+# --- budget file -------------------------------------------------------------
+
+
+def load_spmd_budget(path: Path) -> Dict[str, Any]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return dict(data.get("cores", {}))
+
+
+def write_spmd_budget(
+    path: Path, reports: Sequence[SpmdCoreReport], mesh_sizes: Sequence[int]
+) -> None:
+    import jax
+
+    data = {
+        "_meta": {
+            "jax": jax.__version__,
+            "mesh_sizes": list(mesh_sizes),
+            "generated_by": (
+                "python -m citizensassemblies_tpu.lint --spmd "
+                "--update-spmd-budget"
+            ),
+        },
+        "cores": {r.name: r.census for r in reports if r.census is not None},
+    }
+    path.write_text(
+        json.dumps(data, indent=1, sort_keys=True) + "\n", encoding="utf-8"
+    )
+
+
+def spmd_budget_provenance(path: Optional[Path] = None) -> Dict[str, Any]:
+    """Compact provenance of the committed SPMD budget, for bench evidence
+    rows — the same attribution contract as ``ir.budget_provenance``."""
+    path = path or SPMD_BUDGET_PATH
+    if not path.exists():
+        return {"file": path.name, "missing": True}
+    raw = path.read_bytes()
+    data = json.loads(raw.decode("utf-8"))
+    meta = data.get("_meta", {})
+    return {
+        "file": path.name,
+        "sha256": hashlib.sha256(raw).hexdigest()[:12],
+        "cores": len(data.get("cores", {})),
+        "mesh_sizes": meta.get("mesh_sizes"),
+        "jax": meta.get("jax"),
+    }
+
+
+# --- the pass ----------------------------------------------------------------
+
+
+def available_mesh_sizes() -> List[int]:
+    """The MESH_SIZES the current backend can actually build (CI bootstraps
+    8 virtual CPU devices; a smaller host still verifies what it can)."""
+    import jax
+
+    n = len(jax.devices())
+    return [s for s in MESH_SIZES if s <= n]
+
+
+def run_spmd_checks(
+    entries: Optional[Sequence[CoreEntry]] = None,
+    spmd_entries: Optional[Sequence[SpmdEntry]] = None,
+    budget_path: Optional[Path] = None,
+    update_budget: bool = False,
+    mesh_sizes: Optional[Sequence[int]] = None,
+    precision_out: Optional[Path] = None,
+) -> SpmdReport:
+    """Verify every registered core (or ``entries``) against the SPMD budget.
+
+    ``update_budget=True`` re-measures and REWRITES the budget file (the
+    deliberate ratchet move); S1 violations are then dropped — the new
+    budget is the measurement — while S2/S3 still fail. ``precision_out``
+    writes the S3 artifact (``PRECISION_FLOW.json`` in CI).
+    """
+    budget_path = Path(budget_path) if budget_path is not None else SPMD_BUDGET_PATH
+    entries = list(entries) if entries is not None else collect()
+    spmd_by_name = {
+        e.name: e
+        for e in (spmd_entries if spmd_entries is not None else collect_spmd())
+    }
+    sizes = list(mesh_sizes) if mesh_sizes is not None else available_mesh_sizes()
+    budgets = load_spmd_budget(budget_path)
+
+    reports = [
+        verify_spmd_core(e, spmd_by_name.get(e.name), budgets.get(e.name), sizes)
+        for e in entries
+    ]
+
+    if update_budget:
+        write_spmd_budget(budget_path, reports, sizes)
+        for rep in reports:
+            rep.violations = [v for v in rep.violations if v.rule != "S1"]
+    else:
+        known = {e.name for e in entries}
+        for name in sorted(set(budgets) - known):
+            reports.append(
+                SpmdCoreReport(
+                    name=name,
+                    path=str(budget_path.name),
+                    line=1,
+                    violations=[
+                        Violation(
+                            path=str(budget_path.name), line=1, col=0,
+                            rule="S1", name="stale-budget-entry",
+                            message=(
+                                f"[{name}] SPMD budget entry has no "
+                                "registered core — remove it via "
+                                "--update-spmd-budget"
+                            ),
+                        )
+                    ],
+                )
+            )
+
+    report = SpmdReport(
+        cores=reports,
+        budget_path=str(budget_path),
+        mesh_sizes=sizes,
+        updated=update_budget,
+    )
+    if precision_out is not None:
+        Path(precision_out).write_text(
+            json.dumps(precision_report(report), indent=1, sort_keys=True) + "\n",
+            encoding="utf-8",
+        )
+    return report
+
+
+def precision_report(report: SpmdReport) -> Dict[str, Any]:
+    """The S3 artifact: every core's intermediate classification counts and
+    the per-core cert-isolation verdict."""
+    import jax
+
+    return {
+        "_meta": {
+            "jax": jax.__version__,
+            "classes": ["bf16_safe", "f32_pinned", "f64_certification", "non_float"],
+            "generated_by": "python -m citizensassemblies_tpu.lint --spmd",
+        },
+        "cores": {
+            r.name: r.precision for r in report.cores if r.precision is not None
+        },
+    }
+
+
+def spmd_budget_diff(report: SpmdReport) -> Dict[str, Any]:
+    """Measured-vs-budget comparison for the CI build artifact, with the
+    ``spmd_deltas`` communication-scaling table (the mesh-size growth of
+    each swept core's collective count — the weak-scaling comm evidence,
+    mirroring ``sparse_deltas`` in the IR diff)."""
+    budgets = load_spmd_budget(Path(report.budget_path))
+    cores: Dict[str, Any] = {}
+    deltas: Dict[str, Any] = {}
+    for rep in report.cores:
+        entry: Dict[str, Any] = {"status": "PASS" if rep.ok else "FAIL"}
+        if rep.census is not None:
+            entry["measured"] = rep.census
+            budget = budgets.get(rep.name)
+            if budget:
+                entry["budget"] = budget
+        cores[rep.name] = entry
+        mesh_keys = sorted(
+            (k for k in (rep.census or {}) if k.startswith("mesh")),
+            key=lambda k: int(k[4:]),
+        )
+        if len(mesh_keys) >= 2:
+            per_size = {
+                k: sum(rep.census[k].values()) for k in mesh_keys
+            }
+            first, last = mesh_keys[0], mesh_keys[-1]
+            deltas[rep.name] = {
+                "per_size": per_size,
+                f"{first}_total": per_size[first],
+                f"{last}_total": per_size[last],
+                "growth": per_size[last] - per_size[first],
+                "loop_exempt": rep.loop_exempt,
+            }
+    return {
+        "budget_file": report.budget_path,
+        "mesh_sizes": report.mesh_sizes,
+        "provenance": spmd_budget_provenance(Path(report.budget_path)),
+        "spmd_deltas": deltas,
+        "cores": cores,
+    }
+
+
+def render_spmd_report(report: SpmdReport) -> str:
+    """graftlint-style text: violations in file:line form, then per-core
+    PASS/FAIL lines, then the summary tail."""
+    lines = [v.render() for v in report.violations]
+    for rep in sorted(report.cores, key=lambda r: r.name):
+        status = "PASS" if rep.ok else "FAIL"
+        extra = ""
+        if rep.census is not None:
+            total = sum(sum(c.values()) for c in rep.census.values())
+            extra = f" (collectives={total} over {len(rep.census)} build(s))"
+        lines.append(f"{rep.path}:{rep.line}: {status} [{rep.name}]{extra}")
+    n_fail = sum(1 for r in report.cores if not r.ok)
+    lines.append(
+        f"graftspmd: {len(report.cores)} core(s) verified at mesh sizes "
+        f"{report.mesh_sizes}, {n_fail} failing, budget={report.budget_path}"
+        + (" (updated)" if report.updated else "")
+    )
+    return "\n".join(lines)
+
+
+def spmd_report_as_json(report: SpmdReport) -> Dict[str, Any]:
+    """Stable JSON schema shared with the AST and IR passes."""
+    return {
+        "schema_version": 1,
+        "pass": "spmd",
+        "ok": report.ok,
+        "budget": report.budget_path,
+        "mesh_sizes": report.mesh_sizes,
+        "updated": report.updated,
+        "cores": [
+            {
+                "core": rep.name,
+                "path": rep.path,
+                "line": rep.line,
+                "status": "PASS" if rep.ok else "FAIL",
+                "census": rep.census,
+                "precision": rep.precision,
+            }
+            for rep in sorted(report.cores, key=lambda r: r.name)
+        ],
+        "violations": [dataclasses.asdict(v) for v in report.violations],
+    }

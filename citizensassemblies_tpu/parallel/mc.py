@@ -32,7 +32,11 @@ from jax.sharding import PartitionSpec as P
 from citizensassemblies_tpu.core.instance import DenseInstance
 from citizensassemblies_tpu.dist import partition as dist_partition
 from citizensassemblies_tpu.dist.runtime import AXIS_AGENTS, AXIS_CHAINS, CHAIN_AXES
-from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.lint.registry import (
+    IRCase,
+    register_ir_core,
+    register_spmd_core,
+)
 from citizensassemblies_tpu.models.legacy import _sample_panels_kernel, chain_keys_for
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.parallel.mesh import shard_map_compat
@@ -469,5 +473,40 @@ def _build_dropout_realization_case() -> IRCase:
             jax.ShapeDtypeStruct((F,), i32),
             jax.ShapeDtypeStruct((F,), i32),
             jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+        ),
+    )
+
+
+@register_spmd_core("mc.dropout_realization")
+def _spmd_dropout_realization(mesh) -> IRCase:
+    """graftspmd build: the chain-sharded production wrapper (per-device
+    vmapped draws, psum'd counts) at 8 draws per device — the global key
+    batch scales with the swept mesh so every size keeps the same per-shard
+    program. The bare shard_map callable has no ``.lower``; the verifier
+    needs the jitted form, so this builder jits it per swept mesh (cheap,
+    lint-only — the production path stays on the memoized cache)."""
+    ndev = int(mesh.devices.size)
+    B_local = 8
+    C, n, F = 12, 40, 6
+    f32 = jnp.float32
+    i32 = jnp.int32
+    fn = jax.jit(_dropout_shard_callable(mesh, B_local, "type"))  # graftlint: disable=R2 -- verifier-only rewrap; production dispatch uses the _DROPOUT_SHARD_CACHE memo
+    return IRCase(
+        fn=fn,
+        args=(
+            jax.ShapeDtypeStruct((C, n), jnp.bool_),
+            jax.ShapeDtypeStruct((C,), f32),
+            jax.ShapeDtypeStruct((n,), f32),
+            jax.ShapeDtypeStruct((n,), i32),
+            jax.ShapeDtypeStruct((n,), i32),
+            jax.ShapeDtypeStruct((n, F), jnp.bool_),
+            jax.ShapeDtypeStruct((F,), i32),
+            jax.ShapeDtypeStruct((F,), i32),
+            jax.ShapeDtypeStruct((B_local * ndev, 2), jnp.uint32),
+        ),
+        arg_roles=(
+            "replicated", "replicated", "replicated", "replicated",
+            "replicated", "replicated", "replicated", "replicated",
+            "chain_batch",
         ),
     )

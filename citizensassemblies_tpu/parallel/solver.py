@@ -40,10 +40,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.dist import partition as dist_partition
+from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core, register_spmd_core
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.parallel.mesh import shard_map_compat
 from citizensassemblies_tpu.solvers.highs_backend import DualSolution
@@ -391,6 +392,60 @@ def _ir_sharded_dual_lp_ell() -> IRCase:
     )
 
 
+@register_spmd_core(
+    "parallel.sharded_dual_lp",
+    loop_collectives=(
+        "row-sharded GEMV: the per-iteration psum over G^T lambda IS the "
+        "algorithm — each device owns a row shard, the dual ascent direction "
+        "is their sum; see _sharded_core"
+    ),
+)
+def _spmd_sharded_dual_lp(mesh) -> IRCase:
+    """graftspmd build at the swept virtual mesh: same (rows, nv) problem as
+    the IR registration, rows divisible by every swept size (64 / 8)."""
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    rows, nv = 64, 33
+    return IRCase(
+        fn=_get_sharded_jit(mesh, block_iters=128, max_blocks=8),
+        args=(
+            S((rows, nv), f32), S((rows,), f32), S((nv,), f32),
+            S((nv,), f32), S((1,), f32), S((1,), f32),
+        ),
+        arg_roles=(
+            "rows", "rows", "replicated", "replicated", "replicated",
+            "replicated",
+        ),
+        donate_expected=1,
+    )
+
+
+@register_spmd_core(
+    "parallel.sharded_dual_lp_ell",
+    loop_collectives=(
+        "row-sharded ELL GEMV: same per-iteration psum as the dense twin — "
+        "the reduction over row shards is the dual ascent step itself"
+    ),
+)
+def _spmd_sharded_dual_lp_ell(mesh) -> IRCase:
+    """The ELL twin's graftspmd build, packed at the registration's k_pad."""
+    S = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+    rows, nv, kp = 64, 33, 8
+    return IRCase(
+        fn=_get_sharded_jit_ell(mesh, block_iters=128, max_blocks=8),
+        args=(
+            S((rows, kp), i32), S((rows, kp), f32), S((rows,), f32),
+            S((nv,), f32), S((nv,), f32), S((1,), f32), S((1,), f32),
+        ),
+        arg_roles=(
+            "rows", "rows", "rows", "replicated", "replicated", "replicated",
+            "replicated",
+        ),
+        donate_expected=1,
+    )
+
+
 def _run_core(
     mesh: Mesh,
     G: np.ndarray,
@@ -413,11 +468,10 @@ def _run_core(
     the executable without any host-side re-layout of the carry. ``h`` is
     donated (it is shape/sharding-matched with the returned λ shard), freeing
     its buffer for the output instead of allocating a fresh one per round."""
-    axes = mesh.axis_names
     core = _get_sharded_jit(mesh, block_iters, max_blocks)
-    row_sharding = NamedSharding(mesh, P(axes, None))
-    vec_sharding = NamedSharding(mesh, P(axes))
-    rep_sharding = NamedSharding(mesh, P())
+    row_sharding = dist_partition.rows(mesh, 2)
+    vec_sharding = dist_partition.rows(mesh, 1)
+    rep_sharding = dist_partition.replicated(mesh)
     G_dev = jax.device_put(np.asarray(G, np.float32), row_sharding)
     h_dev = jax.device_put(np.asarray(h, np.float32), vec_sharding)
     c_dev = jax.device_put(np.asarray(c, np.float32), rep_sharding)
@@ -454,11 +508,10 @@ def _run_core_ell(
     """:func:`_run_core` for the ELL program: the packed index/value shards
     upload pre-partitioned over the row axis, everything else replicated —
     same guard, donation and executable-reuse contract."""
-    axes = mesh.axis_names
     core = _get_sharded_jit_ell(mesh, block_iters, max_blocks)
-    row_sharding = NamedSharding(mesh, P(axes, None))
-    vec_sharding = NamedSharding(mesh, P(axes))
-    rep_sharding = NamedSharding(mesh, P())
+    row_sharding = dist_partition.rows(mesh, 2)
+    vec_sharding = dist_partition.rows(mesh, 1)
+    rep_sharding = dist_partition.replicated(mesh)
     idx_dev = jax.device_put(np.asarray(idx, np.int32), row_sharding)
     val_dev = jax.device_put(np.asarray(val, np.float32), row_sharding)
     h_dev = jax.device_put(np.asarray(h, np.float32), vec_sharding)

@@ -56,7 +56,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
+from citizensassemblies_tpu.lint.registry import (
+    IRCase,
+    register_ir_core,
+    register_spmd_core,
+)
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
@@ -258,6 +262,33 @@ def _ir_batch_core() -> IRCase:
             S((B, nv), f32), S((B, m1), f32), S((B, m2), f32), S((B,), f32),
         ),
         donate_expected=3,  # the stacked x0/lam0/mu0 carries
+    )
+
+
+@register_spmd_core("batch_lp.vmapped_core")
+def _spmd_batch_core(mesh) -> IRCase:
+    """graftspmd build: the same vmapped bucket core, B=8 lanes so the
+    batch axis divides every swept mesh size, every operand in the declared
+    ``bucket`` layout (leading instance axis over the whole mesh) — the
+    layout :func:`prepartition` commits before dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    B, nv, m1, m2 = 8, 65, 64, 1
+    return IRCase(
+        fn=_get_batch_core(1024, 128),
+        args=(
+            S((B, nv), f32), S((B, m1, nv), f32), S((B, m1), f32),
+            S((B, m2, nv), f32), S((B, m2), f32),
+            S((B, nv), f32), S((B, m1), f32), S((B, m2), f32), S((B,), f32),
+        ),
+        arg_roles=(
+            "bucket", "bucket", "bucket", "bucket", "bucket", "bucket",
+            "bucket", "bucket", "bucket",
+        ),
+        donate_expected=3,
     )
 
 
@@ -472,17 +503,14 @@ def solve_lp_batch(
                 log=log,
             )
         elif mesh is not None and int(mesh.devices.size) > 1:
-            # legacy per-call layout (dist_prepartition=False escape hatch)
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
+            # legacy per-call layout (dist_prepartition=False escape hatch):
+            # same bucket spec, placed without the reshard accounting
+            from citizensassemblies_tpu.dist import partition as dist_partition
 
-            axes = mesh.axis_names
-
-            def put(a):
-                spec = P(axes, *([None] * (a.ndim - 1)))
-                return jax.device_put(a, NamedSharding(mesh, spec))
-
-            operands = tuple(put(a) for a in (c, G, h, A, b, x0, lam0, mu0, tols))
+            operands = tuple(
+                jax.device_put(a, dist_partition.bucket(mesh, a.ndim))
+                for a in (c, G, h, A, b, x0, lam0, mu0, tols)
+            )
         else:
             operands = tuple(
                 jnp.asarray(a) for a in (c, G, h, A, b, x0, lam0, mu0, tols)

@@ -487,6 +487,14 @@ class Config:
     #: --dist``). ``False`` falls back to the per-call ad-hoc layout the
     #: engine used before graftpod (kept as a diagnostic escape hatch).
     dist_prepartition: bool = True
+    #: graftspmd (``lint/spmd.py``) implicit-replication threshold, bytes: a
+    #: registered core argument with NO declared ``dist/partition.py`` role
+    #: larger than this is flagged at mesh sizes > 1 — an implicitly
+    #: replicated mega-operand costs its full footprint on every device.
+    #: Declare the argument ``"replicated"`` when that IS the intended
+    #: layout; the default (1 MiB) lets scalars, quota vectors and
+    #: per-feature tables through.
+    spmd_replicated_bytes_max: int = 1 << 20
 
     # --- backends -------------------------------------------------------------
     #: "jax" (TPU-first, stochastic pricing + PDHG, exact certification),

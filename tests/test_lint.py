@@ -794,3 +794,86 @@ def test_r11_inert_without_catalogue_in_scope(tmp_path):
         "    log.count('whatever')\n"
     )})
     assert "R11" not in _rules(report), render_report(report)
+
+
+# --- R12: sharding-spec hygiene ----------------------------------------------
+
+
+def test_r12_inline_named_sharding_flagged(tmp_path):
+    report = _lint(tmp_path, {"mod.py": (
+        "from jax.sharding import NamedSharding\n"
+        "def place(mesh, spec, put):\n"
+        "    s = NamedSharding(mesh, spec)\n"
+        "    return put(s)\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R12"]
+    assert len(viols) == 1, render_report(report)
+    assert "inline NamedSharding construction" in viols[0].message
+
+
+def test_r12_partition_module_closures_and_factories_exempt(tmp_path):
+    report = _lint(tmp_path, {
+        # the one legal definition site
+        "dist/partition.py": (
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+            "def rows(mesh):\n"
+            "    return NamedSharding(mesh, P(mesh.axis_names[0]))\n"
+        ),
+        # P() as the block specs of a mesh closure: legal
+        "mod.py": (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "_CACHE = {}\n"
+            "def g(core, mesh):\n"
+            "    key = (mesh, 'g')\n"
+            "    fn = _CACHE.get(key)\n"
+            "    if fn is None:\n"
+            "        fn = jax.shard_map(core, mesh=mesh, in_specs=P(), out_specs=P())\n"
+            "        _CACHE[key] = fn\n"
+            "    return fn\n"
+        ),
+        # factory returning the bound spec: legal, same judgement as R2/R10
+        "fact.py": (
+            "from jax.sharding import NamedSharding\n"
+            "def make(mesh, spec):\n"
+            "    s = NamedSharding(mesh, spec)\n"
+            "    return s\n"
+        ),
+        # an unrelated local P helper is never claimed
+        "other.py": (
+            "def P(x):\n"
+            "    return x + 1\n"
+            "def h(y):\n"
+            "    return P(y)\n"
+        ),
+    })
+    assert "R12" not in _rules(report), render_report(report)
+
+
+def test_r12_unknown_axis_literal_flagged(tmp_path):
+    report = _lint(tmp_path, {
+        "dist/runtime.py": "AXIS_CHAINS = 'chains'\n",
+        "mod.py": (
+            "import jax\n"
+            "def f(x, axis_name):\n"
+            "    jax.lax.psum(x, 'chanis')\n"  # typo'd axis: R12
+            "    jax.lax.pmax(x, axis_name)\n"  # parameter: fine
+            "    return jax.lax.psum(x, 'chains')\n"  # KNOWN literal: R10's claim
+        ),
+    })
+    viols = [v for v in report.violations if v.rule == "R12"]
+    assert len(viols) == 1, render_report(report)
+    assert "'chanis'" in viols[0].message
+    # the known-literal complement stays R10's finding, not double-reported
+    assert any(
+        v.rule == "R10" and "'chains'" in v.message for v in report.violations
+    ), render_report(report)
+
+
+def test_r12_test_modules_exempt(tmp_path):
+    report = _lint(tmp_path, {"tests/test_mod.py": (
+        "from jax.sharding import NamedSharding\n"
+        "def test_place(mesh, spec):\n"
+        "    NamedSharding(mesh, spec)\n"
+    )})
+    assert "R12" not in _rules(report), render_report(report)

@@ -1,0 +1,455 @@
+"""graftspmd self-tests (lint/spmd.py + the SPMD registry).
+
+Mirrors test_ir_check.py's contract, three layers:
+
+* fixture cores deliberately embedding each regression class — a psum
+  inside a while-loop body, an undeclared (implicitly replicated)
+  mega-operand, an extra all-gather the budget has never seen — each FAIL
+  with the right S-rule;
+* the census ratchet: ``--update-spmd-budget`` round-trips to a clean pass,
+  removing a budgeted collective kind fails as ``new-collective``, lowering
+  its count fails as ``collective-count-exceeded``; the compiled-HLO and
+  StableHLO parsers are unit-tested on synthetic text;
+* the real package: the SPMD registrations resolve against the IR registry,
+  a swept core verifies PASS against the committed ``SPMD_BUDGET.json``,
+  and the committed ``PRECISION_FLOW.json`` classifies every registered
+  core with the cert-isolation invariant holding.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from citizensassemblies_tpu.dist import partition as dist_partition
+from citizensassemblies_tpu.dist.runtime import topology_mesh
+from citizensassemblies_tpu.lint.registry import (
+    CoreEntry,
+    IRCase,
+    SpmdEntry,
+    collect,
+    collect_spmd,
+)
+from citizensassemblies_tpu.lint.spmd import (
+    PRECISION_FLOW_PATH,
+    SPMD_BUDGET_PATH,
+    collective_census,
+    loop_collectives,
+    param_shardings,
+    render_spmd_report,
+    run_spmd_checks,
+    spmd_budget_diff,
+    spmd_budget_provenance,
+    spmd_report_as_json,
+)
+from citizensassemblies_tpu.parallel.mesh import shard_map_compat
+
+S = jax.ShapeDtypeStruct
+F32 = jnp.float32
+
+
+def _entry(name: str, build) -> CoreEntry:
+    return CoreEntry(name=name, path=f"tests/fixtures/{name}.py", line=1, build=build)
+
+
+def _spmd(name: str, build, loop_collectives=None) -> SpmdEntry:
+    return SpmdEntry(
+        name=name, path=f"tests/fixtures/{name}.py", line=1, build=build,
+        loop_collectives=loop_collectives,
+    )
+
+
+def _names(report):
+    return {v.name for v in report.violations}
+
+
+# --- fixture cores -----------------------------------------------------------
+
+#: mesh-keyed memo for the fixture closures (the _CORE_CACHE idiom)
+_FIXTURE_FNS = {}
+
+
+def _loop_psum_fn(mesh):
+    """A while loop whose BODY psums every iteration — the per-iteration
+    communication class S2 flags without a reasoned exemption."""
+    key = (mesh, "loop_psum")
+    fn = _FIXTURE_FNS.get(key)
+    if fn is None:
+        axes = mesh.axis_names
+
+        def core(x):
+            def cond(c):
+                return c[0] < 4
+
+            def body(c):
+                i, v = c
+                return i + 1, v + jax.lax.psum(v, axes)
+
+            return jax.lax.while_loop(cond, body, (jnp.int32(0), x))[1]
+
+        fn = jax.jit(
+            shard_map_compat(
+                core, mesh=mesh, in_specs=(P(axes),), out_specs=P(axes)
+            )
+        )
+        _FIXTURE_FNS[key] = fn
+    return fn
+
+
+def _loop_psum_build(mesh):
+    return IRCase(fn=_loop_psum_fn(mesh), args=(S((16,), F32),), arg_roles=("rows",))
+
+
+def _mega_fn(mesh):
+    key = (mesh, "mega")
+    fn = _FIXTURE_FNS.get(key)
+    if fn is None:
+        fn = jax.jit(lambda big, x: (big @ x).sum())
+        _FIXTURE_FNS[key] = fn
+    return fn
+
+
+def _mega_build(mesh):
+    """600x600 f32 = 1.44 MB with NO declared role — above the default
+    spmd_replicated_bytes_max, silently replicated on every device."""
+    return IRCase(
+        fn=_mega_fn(mesh),
+        args=(S((600, 600), F32), S((600,), F32)),
+        arg_roles=(None, "replicated"),
+    )
+
+
+def _gather_fn(mesh):
+    key = (mesh, "gather")
+    fn = _FIXTURE_FNS.get(key)
+    if fn is None:
+        repl = dist_partition.replicated(mesh, 1)
+        fn = jax.jit(
+            lambda x: jax.lax.with_sharding_constraint(x * 2.0, repl)
+        )
+        _FIXTURE_FNS[key] = fn
+    return fn
+
+
+def _gather_build(mesh):
+    """Row-sharded input forced replicated — the partitioner inserts the
+    all-gather this fixture's budget tests ratchet against."""
+    return IRCase(fn=_gather_fn(mesh), args=(S((16,), F32),), arg_roles=("rows",))
+
+
+def _cert_build():
+    @jax.jit
+    def f(x):
+        y = x * 2.0  # f32 intermediate feeding the f64 sink -> pinned
+        z = y.astype(jnp.float64)  # graftlint: disable=R4 -- deliberate S3 fixture: the cert sink under test
+        return (z * z).sum()
+
+    return IRCase(fn=f, args=(S((8,), F32),), allow_f64=True)
+
+
+# --- compiled-HLO / StableHLO parser units -----------------------------------
+
+_SYNTH_HLO = """\
+HloModule fixture
+
+%sum (a: f32[], b: f32[]) -> f32[] {
+  ROOT %add = f32[] add(f32[] %a, f32[] %b)
+}
+
+%body (p: (s32[], f32[8])) -> (s32[], f32[8]) {
+  %all-reduce.1 = f32[8]{0} all-reduce(f32[8]{0} %v), to_apply=%sum
+  ROOT %tup = (s32[], f32[8]{0}) tuple(s32[] %i, f32[8]{0} %all-reduce.1)
+}
+
+%cond (p: (s32[], f32[8])) -> pred[] {
+  %all-reduce.2 = f32[8]{0} all-reduce(f32[8]{0} %w), to_apply=%sum
+  ROOT %lt = pred[] compare(s32[] %i, s32[] %four), direction=LT
+}
+
+ENTRY %main (a: f32[8]) -> f32[16] {
+  %ag = f32[16]{0} all-gather-start(f32[8]{0} %a), dimensions={0}
+  %agd = f32[16]{0} all-gather-done(f32[16]{0} %ag)
+  %w.8 = (s32[], f32[8]{0}) while((s32[], f32[8]{0}) %init), condition=%cond, body=%body
+  ROOT %r = f32[16]{0} copy(f32[16]{0} %agd)
+}
+"""
+
+
+def test_census_counts_starts_once_and_skips_operand_refs():
+    census = collective_census(_SYNTH_HLO)
+    # -start counted once, -done and %all-reduce.N operand refs not at all
+    assert census == {"all-gather": 1, "all-reduce": 2}
+
+
+def test_loop_collectives_sees_bodies_not_conditions():
+    # the condition's all-reduce (a check-every convergence reduction) is
+    # exempt by design; only the body's counts as per-iteration comms
+    assert loop_collectives(_SYNTH_HLO) == ["all-reduce"]
+
+
+def test_param_shardings_parses_nested_brace_annotations():
+    text = (
+        'func.func public @main(%arg0: tensor<64x33xf32> '
+        '{mhlo.sharding = "{devices=[2,1]<=[2]}"}, '
+        '%arg1: tensor<33xf32> {jax.buffer_donor = true, '
+        'mhlo.sharding = "{replicated}"}, '
+        '%arg2: tensor<1xf32>) -> (tensor<33xf32>) {\n'
+        "  return %arg2\n}"
+    )
+    assert param_shardings(text) == [
+        "{devices=[2,1]<=[2]}", "{replicated}", None,
+    ]
+
+
+# --- fixture regression classes ----------------------------------------------
+
+
+def test_mid_loop_psum_fails(tmp_path):
+    report = run_spmd_checks(
+        entries=[_entry("fixture.loop_psum", lambda: _loop_psum_build(topology_mesh(1)))],
+        spmd_entries=[_spmd("fixture.loop_psum", _loop_psum_build)],
+        budget_path=tmp_path / "b.json",
+        update_budget=True,  # isolate S2 from the missing-budget failure
+        mesh_sizes=[2],
+    )
+    assert "collective-in-loop-body" in _names(report), render_spmd_report(report)
+
+
+def test_mid_loop_psum_passes_with_reasoned_exemption(tmp_path):
+    report = run_spmd_checks(
+        entries=[_entry("fixture.loop_psum", lambda: _loop_psum_build(topology_mesh(1)))],
+        spmd_entries=[
+            _spmd(
+                "fixture.loop_psum", _loop_psum_build,
+                loop_collectives="fixture: the per-iteration psum is the point",
+            )
+        ],
+        budget_path=tmp_path / "b.json",
+        update_budget=True,
+        mesh_sizes=[2],
+    )
+    assert report.ok, render_spmd_report(report)
+
+
+def test_undeclared_mega_operand_fails(tmp_path):
+    report = run_spmd_checks(
+        entries=[_entry("fixture.mega", lambda: _mega_build(topology_mesh(1)))],
+        spmd_entries=[_spmd("fixture.mega", _mega_build)],
+        budget_path=tmp_path / "b.json",
+        update_budget=True,
+        mesh_sizes=[2],
+    )
+    assert "implicit-replication" in _names(report), render_spmd_report(report)
+    assert any("declared dist/partition.py role" in v.message for v in report.violations)
+
+
+# --- the census ratchet ------------------------------------------------------
+
+
+def _measure_gather(tmp_path):
+    budget = tmp_path / "budget.json"
+    report = run_spmd_checks(
+        entries=[_entry("fixture.gather", lambda: _gather_build(topology_mesh(1)))],
+        spmd_entries=[_spmd("fixture.gather", _gather_build)],
+        budget_path=budget,
+        update_budget=True,
+        mesh_sizes=[1, 2],
+    )
+    assert report.ok, render_spmd_report(report)
+    data = json.loads(budget.read_text())
+    # the fixture really does compile to an all-gather at 2 devices
+    assert data["cores"]["fixture.gather"]["mesh2"].get("all-gather", 0) >= 1
+    return budget, data
+
+
+def test_update_spmd_budget_round_trips(tmp_path):
+    budget, _ = _measure_gather(tmp_path)
+    report = run_spmd_checks(
+        entries=[_entry("fixture.gather", lambda: _gather_build(topology_mesh(1)))],
+        spmd_entries=[_spmd("fixture.gather", _gather_build)],
+        budget_path=budget,
+        mesh_sizes=[1, 2],
+    )
+    assert report.ok, render_spmd_report(report)
+
+
+def test_unbudgeted_all_gather_fails_as_new_collective(tmp_path):
+    budget, data = _measure_gather(tmp_path)
+    del data["cores"]["fixture.gather"]["mesh2"]["all-gather"]
+    budget.write_text(json.dumps(data))
+    report = run_spmd_checks(
+        entries=[_entry("fixture.gather", lambda: _gather_build(topology_mesh(1)))],
+        spmd_entries=[_spmd("fixture.gather", _gather_build)],
+        budget_path=budget,
+        mesh_sizes=[1, 2],
+    )
+    assert "new-collective" in _names(report), render_spmd_report(report)
+
+
+def test_collective_count_regression_fails(tmp_path):
+    budget, data = _measure_gather(tmp_path)
+    data["cores"]["fixture.gather"]["mesh2"]["all-gather"] = 0
+    budget.write_text(json.dumps(data))
+    report = run_spmd_checks(
+        entries=[_entry("fixture.gather", lambda: _gather_build(topology_mesh(1)))],
+        spmd_entries=[_spmd("fixture.gather", _gather_build)],
+        budget_path=budget,
+        mesh_sizes=[1, 2],
+    )
+    assert "collective-count-exceeded" in _names(report), render_spmd_report(report)
+
+
+def test_stale_budget_entry_fails(tmp_path):
+    budget, data = _measure_gather(tmp_path)
+    data["cores"]["fixture.retired"] = data["cores"]["fixture.gather"]
+    budget.write_text(json.dumps(data))
+    report = run_spmd_checks(
+        entries=[_entry("fixture.gather", lambda: _gather_build(topology_mesh(1)))],
+        spmd_entries=[_spmd("fixture.gather", _gather_build)],
+        budget_path=budget,
+        mesh_sizes=[1, 2],
+    )
+    assert "stale-budget-entry" in _names(report), render_spmd_report(report)
+
+
+def test_budget_diff_carries_spmd_deltas(tmp_path):
+    budget, _ = _measure_gather(tmp_path)
+    report = run_spmd_checks(
+        entries=[_entry("fixture.gather", lambda: _gather_build(topology_mesh(1)))],
+        spmd_entries=[
+            _spmd(
+                "fixture.gather", _gather_build,
+                loop_collectives=None,
+            )
+        ],
+        budget_path=budget,
+        mesh_sizes=[1, 2],
+    )
+    diff = spmd_budget_diff(report)
+    delta = diff["spmd_deltas"]["fixture.gather"]
+    assert delta["per_size"]["mesh2"] >= 1
+    assert delta["growth"] == delta["per_size"]["mesh2"] - delta["per_size"]["mesh1"]
+    assert diff["provenance"]["cores"] == 1
+
+
+# --- S3 precision flow -------------------------------------------------------
+
+
+@pytest.mark.filterwarnings("ignore:Explicitly requested dtype")
+def test_precision_flow_classifies_cert_sink(tmp_path):
+    out = tmp_path / "precision.json"
+    report = run_spmd_checks(
+        entries=[_entry("fixture.cert", _cert_build)],
+        spmd_entries=[],
+        budget_path=tmp_path / "b.json",
+        update_budget=True,
+        precision_out=out,
+    )
+    assert report.ok, render_spmd_report(report)
+    data = json.loads(out.read_text())
+    flow = data["cores"]["fixture.cert"]
+    # the x64 trace sees the deliberate f64 arithmetic, tagged as the sink
+    assert flow["cert_sink"] is True
+    assert flow["f64_certification"] > 0
+    # the f32 intermediate feeding the convert is pinned, never bf16-safe:
+    # the isolation invariant S3 exists to enforce
+    assert flow["cert_isolated"] is True
+    assert flow["f32_pinned"] > 0
+
+
+# --- merged machine schema ---------------------------------------------------
+
+
+def test_three_passes_share_the_json_envelope(tmp_path):
+    from citizensassemblies_tpu.lint.cli import _ast_report_as_json
+    from citizensassemblies_tpu.lint.engine import lint_paths
+    from citizensassemblies_tpu.lint.ir import ir_report_as_json, run_ir_checks
+
+    src = tmp_path / "clean_mod.py"
+    src.write_text("X = 1\n")
+    ast_doc = _ast_report_as_json(lint_paths([src]))
+
+    ir_doc = ir_report_as_json(
+        run_ir_checks(
+            entries=[_entry("fixture.cert", _cert_build)],
+            budget_path=tmp_path / "ir.json",
+            update_budget=True,
+        )
+    )
+    spmd_doc = spmd_report_as_json(
+        run_spmd_checks(
+            entries=[_entry("fixture.cert", _cert_build)],
+            spmd_entries=[],
+            budget_path=tmp_path / "spmd.json",
+            update_budget=True,
+        )
+    )
+    for doc, name in ((ast_doc, "ast"), (ir_doc, "ir"), (spmd_doc, "spmd")):
+        assert doc["schema_version"] == 1
+        assert doc["pass"] == name
+        assert isinstance(doc["ok"], bool)
+        assert isinstance(doc["violations"], list)
+
+
+# --- the real package --------------------------------------------------------
+
+
+def test_spmd_registrations_resolve_against_ir_registry():
+    spmd = collect_spmd()
+    assert len(spmd) >= 4
+    ir_names = {e.name for e in collect()}
+    assert {e.name for e in spmd} <= ir_names
+    # the sharded PDHG cores carry the reasoned per-iteration exemption
+    by_name = {e.name: e for e in spmd}
+    for name in ("parallel.sharded_dual_lp", "parallel.sharded_dual_lp_ell"):
+        assert by_name[name].loop_collectives, name
+
+
+def test_committed_spmd_budget_covers_the_fleet():
+    assert SPMD_BUDGET_PATH.exists(), "run make update-spmd-budget and commit"
+    data = json.loads(SPMD_BUDGET_PATH.read_text())
+    assert data["_meta"]["mesh_sizes"] == [1, 2, 4, 8]
+    registered = {e.name for e in collect()}
+    assert registered <= set(data["cores"])
+    # every swept core budgets every mesh size
+    for e in collect_spmd():
+        assert {"base", "mesh1", "mesh2", "mesh4", "mesh8"} <= set(
+            data["cores"][e.name]
+        ), e.name
+    prov = spmd_budget_provenance()
+    assert prov["cores"] == len(data["cores"]) and "sha256" in prov
+
+
+def test_real_sharded_core_passes_against_committed_budget():
+    entries = {e.name: e for e in collect()}
+    spmd = {e.name: e for e in collect_spmd()}
+    name = "parallel.sharded_dual_lp"
+    report = run_spmd_checks(
+        entries=[entries[name]],
+        spmd_entries=[spmd[name]],
+        budget_path=SPMD_BUDGET_PATH,
+        mesh_sizes=[2],
+    )
+    # scoped run: ignore staleness of every OTHER committed budget entry —
+    # the full-fleet check is `make check-spmd` (CI)
+    real = [v for v in report.violations if v.name != "stale-budget-entry"]
+    assert not real, render_spmd_report(report)
+    core = next(c for c in report.cores if c.name == name)
+    assert core.census["mesh2"] == {"all-reduce": 11}
+
+
+def test_committed_precision_flow_classifies_every_core():
+    assert PRECISION_FLOW_PATH.exists(), "run make check-spmd and commit"
+    data = json.loads(PRECISION_FLOW_PATH.read_text())
+    registered = {e.name for e in collect()}
+    assert registered <= set(data["cores"])
+    for name, flow in data["cores"].items():
+        total = (
+            flow["bf16_safe"] + flow["f32_pinned"]
+            + flow["f64_certification"] + flow["non_float"]
+        )
+        assert total == flow["total"] > 0, name
+        # no bf16-safe intermediate touches a certification path, anywhere
+        assert flow["cert_isolated"] is True, name
