@@ -46,3 +46,24 @@ update-spmd-budget:
 # no accelerator stack needed, same posture as `lint`.
 trend:
 	python bench.py --trend
+
+# graftboot (aot/): build the AOT-serialized executable cache artifact at
+# the service shapes. On CPU the legacy runtime flag is mandatory — thunk
+# runtime executables do not survive cross-process deserialization — and
+# the persistent XLA disk cache must be off so the serialized payloads come
+# from this process's compiler (see aot/build.py).
+aot-cache:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_cpu_use_thunk_runtime=false CITIZENS_TPU_NO_COMPILE_CACHE=1 python -m citizensassemblies_tpu.aot build --profile service
+
+# graftboot coldboot evidence (bench.py --coldboot --smoke): build a cache,
+# fork a FRESH interpreter per variant (cached / uncached) through the
+# identical boot → fleet-prewarm → serve readiness contract, gate the
+# cached child's flagship serve at ZERO XLA compilations and the two
+# allocations bit-identical. The full (non-smoke) run also gates the >= 3x
+# cold-boot-to-first-certified-result speedup and writes the committed
+# BENCH_coldboot_r*.json trend row.
+coldboot-smoke:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --coldboot --smoke
+
+coldboot:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --coldboot

@@ -2896,6 +2896,235 @@ def dist_bench(smoke_mode: bool) -> int:
     return proc.returncode
 
 
+def coldboot_bench_child(variant: str, smoke_mode: bool) -> int:
+    """``bench.py --coldboot`` (child, FRESH interpreter): one boot-to-
+    first-certified-result run under the graftboot readiness contract.
+
+    Both variants execute the IDENTICAL sequence — construct a
+    ``SelectionService`` (which boots the AOT store), warm the flagship
+    request class's featurization shapes, replay the predicted bucket
+    lattice (``aot.build.bucket_lattice_workload`` — the same function the
+    cache was built from), then serve one flagship request under a
+    :class:`CompilationGuard`. The only difference is ``Config.aot_cache``:
+    ``cached`` deserializes every lattice executable, ``uncached`` pays each
+    bucket's full XLA compile. The cached variant GATES zero compiles
+    inside the serve window; both report an allocation checksum so the
+    parent can pin bit-identity between the two paths.
+    """
+    t0 = time.perf_counter()
+    import hashlib
+
+    import numpy as np
+
+    from citizensassemblies_tpu.aot.build import (
+        bucket_lattice_workload,
+        coldboot_config,
+        flagship_instance,
+    )
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.service import SelectionRequest, SelectionService
+    from citizensassemblies_tpu.utils.guards import CompilationGuard
+
+    profile = "smoke" if smoke_mode else "service"
+    cfg = coldboot_config().replace(
+        aot_cache=(variant == "cached"),
+        aot_cache_path=os.environ.get("BENCH_COLDBOOT_CACHE", ""),
+        aot_prewarm=False,  # symmetric children: no off-thread warm racing
+    )
+    t_import = time.perf_counter()
+
+    svc = SelectionService(cfg)  # boots (or skips) the AOT store
+    t_boot = time.perf_counter()
+
+    # readiness contract: warm the flagship request CLASS's featurization
+    # (same shapes, different seed — first-touch eager converts) and the
+    # predicted bucket lattice, then serve
+    featurize(flagship_instance(seed=1))
+    lattice = bucket_lattice_workload(cfg, profile)
+    t_warm = time.perf_counter()
+
+    with CompilationGuard(name="coldboot_serve") as guard:
+        res = svc.run(
+            SelectionRequest(instance=flagship_instance(), tenant="coldboot"),
+            timeout=1200,
+        )
+    t_serve = time.perf_counter()
+
+    alloc = np.asarray(res.allocation, dtype=np.float64)
+    checksum = hashlib.sha256(np.round(alloc, 9).tobytes()).hexdigest()[:16]
+    certified = bool(res.audit.get("contract_ok", True))
+    store = svc.aot_store
+    report = {
+        "variant": variant,
+        "import_s": round(t_import - t0, 3),
+        "boot_s": round(t_boot - t_import, 3),
+        "warm_s": round(t_warm - t_boot, 3),
+        "serve_s": round(t_serve - t_warm, 3),
+        "total_s": round(t_serve - t0, 3),
+        "lattice_buckets": lattice["buckets"],
+        "serve_compiles": int(guard.count),
+        "compiles_by_core": dict(guard.by_name),
+        "certified": certified,
+        "alloc_checksum": checksum,
+        "aot": store.stamp() if store is not None else None,
+    }
+    failures = []
+    if not certified:
+        failures.append("flagship request served without a certificate")
+    if variant == "cached" and guard.count != 0:
+        failures.append(
+            f"cached coldboot serve window saw {guard.count} XLA "
+            f"compilations (by core: {guard.by_name}) — the gate is 0"
+        )
+    report["failures"] = failures
+    print(json.dumps(report))
+    return 1 if failures else 0
+
+
+def coldboot_bench(smoke_mode: bool) -> int:
+    """``bench.py --coldboot`` (parent): the graftboot evidence harness.
+
+    Builds the cache artifact once (``python -m citizensassemblies_tpu.aot
+    build``), then forks TWO fresh interpreters through the identical
+    readiness contract — cached (``aot_cache=True``) and uncached
+    (``aot_cache=False``) — and measures each child's spawn-to-exit wall
+    clock: the honest cold-boot-to-first-certified-result number, python
+    and jax imports included. Gates: the cached child serves its flagship
+    request with ZERO XLA compilations (enforced in the child), both
+    children produce bit-identical allocations (``aot_cache=False`` is the
+    plain-jit path by construction), and — full mode — the cached boot is
+    ≥ 3× faster. Writes ``artifacts/BENCH_coldboot_smoke.json`` (smoke) or
+    ``artifacts/BENCH_coldboot_r18.json`` with ``coldboot_cached`` /
+    ``coldboot_uncached`` detail rows for the obs/trend.py family loader.
+    """
+    import subprocess
+    import tempfile
+
+    profile = "smoke" if smoke_mode else "service"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    # XLA:CPU thunk-runtime executables do not survive cross-process
+    # deserialization ("Symbols not found") — build AND load legacy (the
+    # runtime choice is part of the artifact fingerprint, store.py)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_cpu_use_thunk_runtime=false"
+    ).strip()
+    # the package-level persistent XLA cache would lend BOTH children warm
+    # compiles from earlier processes on this machine — the uncached child
+    # must pay true cold-start compiles and the builder must serialize
+    # executables from its own compiler, so the whole harness opts out
+    env["CITIZENS_TPU_NO_COMPILE_CACHE"] = "1"
+
+    tmpdir = tempfile.mkdtemp(prefix="coldboot_")
+    cache_path = os.path.join(tmpdir, "aot_cache.pkl")
+    env["BENCH_COLDBOOT_CACHE"] = cache_path
+
+    t0 = time.time()
+    build = subprocess.run(
+        [
+            sys.executable, "-m", "citizensassemblies_tpu.aot", "build",
+            "--out", cache_path, "--profile", profile,
+        ],
+        env=env, capture_output=True, text=True, timeout=3600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    build_s = time.time() - t0
+    if build.returncode != 0 or not os.path.exists(cache_path):
+        sys.stdout.write(build.stdout)
+        sys.stderr.write(build.stderr)
+        print("coldboot bench FAILED: cache build failed")
+        return 1
+    try:  # the build CLI's stdout IS its pretty-printed JSON report
+        build_report = json.loads(build.stdout)
+    except ValueError:
+        build_report = {}
+
+    def _child(variant: str):
+        cmd = [sys.executable, os.path.abspath(__file__), "--coldboot"]
+        if smoke_mode:
+            cmd.append("--smoke")
+        cenv = dict(env)
+        cenv["BENCH_COLDBOOT_CHILD"] = variant
+        t = time.time()
+        proc = subprocess.run(
+            cmd, env=cenv, capture_output=True, text=True, timeout=3600
+        )
+        wall = time.time() - t
+        report = None
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    report = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if report is None:
+            sys.stdout.write(proc.stdout)
+            sys.stderr.write(proc.stderr)
+        return proc.returncode, wall, report
+
+    failures = []
+    rc_u, wall_u, rep_u = _child("uncached")
+    rc_c, wall_c, rep_c = _child("cached")
+    if rep_u is None or rep_c is None:
+        print("coldboot bench FAILED: no report line from a child")
+        return 1
+    failures += rep_u.get("failures", []) + rep_c.get("failures", [])
+    if rc_u != 0:
+        failures.append(f"uncached child exited {rc_u}")
+    if rc_c != 0:
+        failures.append(f"cached child exited {rc_c}")
+    if rep_u["alloc_checksum"] != rep_c["alloc_checksum"]:
+        failures.append(
+            "cached and uncached allocations diverged: "
+            f"{rep_c['alloc_checksum']} != {rep_u['alloc_checksum']}"
+        )
+    ratio = wall_u / max(wall_c, 1e-9)
+    if not smoke_mode and ratio < 3.0:
+        failures.append(
+            f"cached coldboot only {ratio:.2f}x faster (gate: >= 3x)"
+        )
+
+    report = {
+        "schema_version": 1,
+        "coldboot_ok": not failures,
+        "smoke": smoke_mode,
+        "backend": "cpu",
+        "profile": profile,
+        "build_s": round(build_s, 2),
+        "cache_entries": build_report.get("entries"),
+        "cache_sha": build_report.get("sha"),
+        "cached_wall_s": round(wall_c, 2),
+        "uncached_wall_s": round(wall_u, 2),
+        "speedup": round(ratio, 2),
+        "cached": rep_c,
+        "uncached": rep_u,
+        "detail": {
+            "coldboot_cached": {
+                "seconds": round(wall_c, 3),
+                "serve_compiles": rep_c["serve_compiles"],
+                "aot_hits": (rep_c.get("aot") or {}).get("hits", 0),
+            },
+            "coldboot_uncached": {"seconds": round(wall_u, 3)},
+            "coldboot_build": {"seconds": round(build_s, 3)},
+        },
+        "failures": failures,
+    }
+    name = "BENCH_coldboot_smoke.json" if smoke_mode else "BENCH_coldboot_r18.json"
+    out_path = os.environ.get(
+        "BENCH_COLDBOOT_PATH", os.path.join(_artifacts_dir(), name)
+    )
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps({k: v for k, v in report.items() if k not in ("cached", "uncached")}, indent=1))
+    for f in failures:
+        print(f"coldboot bench FAILED: {f}")
+    return 1 if failures else 0
+
+
 if __name__ == "__main__":
     if "--trend" in sys.argv:
         raise SystemExit(trend())
@@ -2909,6 +3138,13 @@ if __name__ == "__main__":
         if os.environ.get("BENCH_DIST_CHILD"):
             raise SystemExit(dist_bench_child(smoke_mode="--smoke" in sys.argv))
         raise SystemExit(dist_bench(smoke_mode="--smoke" in sys.argv))
+    if "--coldboot" in sys.argv:
+        child = os.environ.get("BENCH_COLDBOOT_CHILD")
+        if child:
+            raise SystemExit(
+                coldboot_bench_child(child, smoke_mode="--smoke" in sys.argv)
+            )
+        raise SystemExit(coldboot_bench(smoke_mode="--smoke" in sys.argv))
     if "--kernels" in sys.argv:
         raise SystemExit(kernels_bench(smoke_mode="--smoke" in sys.argv))
     if "--churn" in sys.argv:

@@ -1,0 +1,44 @@
+"""graftboot: AOT-serialized executable cache — kill the cold start.
+
+Build (``python -m citizensassemblies_tpu.aot build`` / ``make aot-cache``)
+records every hot core at its service shapes and serializes the compiled
+executables into a versioned artifact; :func:`boot` loads it at process
+start so the memo factories hand out programs that never touch the XLA
+compiler. See ``store.py`` for the serving contract (tri-state
+``Config.aot_cache``, counted fallbacks, never a crash) and ``build.py``
+for coverage.
+"""
+
+from citizensassemblies_tpu.aot.store import (  # noqa: F401
+    ExecStore,
+    Recorder,
+    SeededJit,
+    active_store,
+    aot_seeded,
+    call_signature,
+    install_recorder,
+    install_store,
+    load_store,
+    platform_fingerprint,
+    resolve_cache_path,
+    save_artifact,
+)
+
+
+def boot(cfg=None, path=None):
+    """Load the cache artifact per ``Config.aot_cache`` and install it.
+
+    * ``None`` (default) — auto: load if an artifact exists, else boot cold.
+    * ``True`` — required: a missing/unreadable/mismatched artifact raises.
+    * ``False`` — hard off: nothing is loaded or installed; with the
+      wrappers pass-through this is bit-identical to the plain JIT path.
+
+    Returns the installed :class:`~.store.ExecStore` (or ``None``).
+    """
+    mode = getattr(cfg, "aot_cache", None) if cfg is not None else None
+    if mode is False:
+        return None
+    store = load_store(path=path, cfg=cfg, require=(mode is True))
+    if store is not None:
+        install_store(store)
+    return store

@@ -1,0 +1,38 @@
+"""CLI: ``python -m citizensassemblies_tpu.aot build`` (see ``make aot-cache``).
+
+Prints the build report as one JSON document; exit 0 when at least one
+entry serialized, 2 when the cache came out empty (nothing to boot from).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m citizensassemblies_tpu.aot")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    b = sub.add_parser("build", help="record service shapes and serialize the cache")
+    b.add_argument(
+        "--out", default=None,
+        help="artifact path (default: CITIZENS_AOT_CACHE or the per-user cache)",
+    )
+    b.add_argument(
+        "--profile", choices=("smoke", "service"), default="smoke",
+        help="shape coverage: smoke = manifest + flagship (CI); "
+        "service = + the wider pool-size sweep",
+    )
+    args = parser.parse_args(argv)
+
+    from citizensassemblies_tpu.aot.build import build_cache
+
+    report = build_cache(path=args.out, profile=args.profile)
+    json.dump(report, sys.stdout, indent=2, default=repr)
+    print()
+    return 0 if report["entries"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,275 @@
+"""graftboot cache builder: record service shapes, serialize every core.
+
+The builder's job is to produce the artifact :func:`~.store.load_store`
+boots from. Coverage comes from two complementary sweeps, both recorded
+through the live ``aot_seeded`` wrappers (so the cache keys are the exact
+signatures the serving path will look up — no hand-maintained shape list):
+
+1. **Manifest walk** — every ``@register_ir_core`` registration, replayed at
+   its budget shapes via ``lint.registry.build_cases()``. This is the same
+   shape manifest ``make check-ir`` certifies, so every core the verifier
+   knows about lands in the cache, including the ELL twins and the
+   two-sided households masters the flagship request may not touch.
+2. **Flagship serve recording** — the coldboot request class
+   (:func:`flagship_instance`) driven through a real ``SelectionService``,
+   which captures the *service* shapes: the power-of-two LP bucket lattice
+   ``solvers/batch_lp.py`` actually dispatches for this instance family,
+   at the batch dims cross-request batching produces. The ``service``
+   profile widens the sweep across more pool sizes (more lattice buckets);
+   ``smoke`` keeps CI inside its minute budget.
+3. **Bucket-lattice sweep** — :func:`bucket_lattice_workload` pushes one
+   inert all-zero batch through every predicted LP bucket
+   (:data:`COLDBOOT_LATTICE`). The SAME function is the boot-time fleet
+   pre-warm, so the shapes the cache was built at and the shapes boot
+   warms are one list that cannot drift.
+
+Each unique (family, signature) is then lowered from its recorded avals,
+compiled, serialized (``jax.experimental.serialize_executable``) and written
+into one versioned artifact (fingerprint + content sha, see ``store.py``).
+Per-entry failures — e.g. a Pallas kernel whose backend refuses
+serialization — are recorded as skips, never a build abort: a partial cache
+still kills most of the cold start, and the skip list names what it misses.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from citizensassemblies_tpu.aot.store import (
+    Recorder,
+    install_recorder,
+    install_store,
+    resolve_cache_path,
+    save_artifact,
+)
+
+#: the coldboot flagship request class — the builder records it and
+#: ``bench.py --coldboot`` serves it, so the two stay in lockstep
+COLDBOOT_SPEC: Dict[str, int] = {"n": 24, "k": 4, "n_categories": 2, "seed": 0}
+
+#: extra pool sizes the ``service`` profile sweeps (more lattice buckets)
+_SERVICE_SWEEP: Tuple[Tuple[int, int], ...] = ((32, 4), (40, 5), (48, 6))
+
+#: the predicted serving lattice: ``(batch, m1, m2, nv)`` power-of-two LP
+#: bucket shapes (``solvers/batch_lp.py`` bucketing) the flagship request
+#: family dispatches at, widened to the neighbouring buckets cross-request
+#: batching and quota churn reach. The builder records THIS list and the
+#: boot-time fleet pre-warm replays THIS list — one shared constant is what
+#: keeps build-time coverage and boot-time readiness in lockstep.
+COLDBOOT_LATTICE: Tuple[Tuple[int, int, int, int], ...] = (
+    (1, 8, 8, 128),
+    (2, 8, 8, 128),
+    (4, 8, 8, 128),
+    (8, 8, 8, 128),
+    (8, 8, 8, 256),
+    (4, 8, 8, 256),
+    (8, 16, 8, 128),
+    (4, 16, 8, 128),
+    (8, 16, 16, 256),
+    (2, 16, 16, 256),
+    (8, 8, 8, 64),
+    (4, 32, 16, 256),
+)
+
+#: wider buckets only the ``service`` profile warms (bigger pools, bigger
+#: caches — not worth CI's minute budget in ``smoke``)
+_LATTICE_SERVICE_EXTRA: Tuple[Tuple[int, int, int, int], ...] = (
+    (8, 8, 8, 512),
+    (16, 16, 16, 256),
+    (8, 32, 16, 512),
+    (16, 8, 8, 128),
+)
+
+
+def lattice_points(profile: str = "smoke") -> Tuple[Tuple[int, int, int, int], ...]:
+    if profile == "service":
+        return COLDBOOT_LATTICE + _LATTICE_SERVICE_EXTRA
+    return COLDBOOT_LATTICE
+
+
+def bucket_lattice_workload(cfg=None, profile: str = "smoke") -> Dict[str, Any]:
+    """Drive one inert all-zero batch through every predicted LP bucket.
+
+    An all-zero instance's KKT residual is zero at the first convergence
+    check (tol pinned to the pad tolerance), so each bucket costs one cheap
+    dispatch — but forces the batch-LP core THROUGH the compiler (or the
+    store) at that exact shape. Run at build time under the recorder this
+    is what populates the lattice; run at boot it is the fleet pre-warm:
+    with a cache the executables deserialize in milliseconds, without one
+    each bucket pays its full XLA compile. Same call, same shapes, both
+    sides — the coldboot bench's readiness contract.
+    """
+    import numpy as np
+
+    from citizensassemblies_tpu.solvers.batch_lp import BatchLP, solve_lp_batch
+
+    cfg = coldboot_config(cfg)
+    points = lattice_points(profile)
+    t0 = time.time()
+    for bsz, m1, m2, nv in points:
+        probs = [
+            BatchLP(
+                c=np.zeros(nv, np.float32),
+                G=np.zeros((m1, nv), np.float32),
+                h=np.zeros(m1, np.float32),
+                A=np.zeros((m2, nv), np.float32),
+                b=np.zeros(m2, np.float32),
+                tol=1.0,
+            )
+            for _ in range(bsz)
+        ]
+        # max_iters pins the core key to the one the leximin master's
+        # pricing batches dispatch (solvers/compositions.py) — the lattice
+        # must warm the SERVING core family, not the cfg-default one
+        solve_lp_batch(probs, cfg=cfg, defer=False, max_iters=8_192)
+    return {"buckets": len(points), "seconds": round(time.time() - t0, 3)}
+
+
+def coldboot_config(base=None):
+    """The config both the builder and the coldboot bench child run under.
+
+    ``lp_batch=True`` forces the batched LP engine on (its CPU auto-route
+    would otherwise turn the flagship path into the unbatched solver and
+    the cache would warm the wrong cores).
+    """
+    from citizensassemblies_tpu.utils.config import default_config
+
+    cfg = base if base is not None else default_config()
+    return cfg.replace(lp_batch=True)
+
+
+def flagship_instance(seed: Optional[int] = None):
+    from citizensassemblies_tpu.core.generator import random_instance
+
+    spec = dict(COLDBOOT_SPEC)
+    if seed is not None:
+        spec["seed"] = seed
+    return random_instance(**spec)
+
+
+def _record_flagship(cfg, profile: str) -> int:
+    """Drive the flagship request class through a real service instance
+    (worker threads, cross-request batcher and all) so the recorder sees
+    the serving-path signatures. Returns the number of requests served."""
+    from citizensassemblies_tpu.core.generator import random_instance
+    from citizensassemblies_tpu.service import SelectionRequest, SelectionService
+
+    svc = SelectionService(cfg)
+    specs = [(flagship_instance(), "build0")]
+    if profile == "service":
+        specs += [
+            (random_instance(n=n, k=k, n_categories=2, seed=i), f"build{i % 3}")
+            for i, (n, k) in enumerate(_SERVICE_SWEEP, start=1)
+        ]
+    chans = [
+        svc.submit(SelectionRequest(instance=inst, tenant=tenant))
+        for inst, tenant in specs
+    ]
+    for ch in chans:
+        ch.result(timeout=1200)
+    return len(specs)
+
+
+def _record_manifest(rec: Recorder) -> Tuple[int, List[str]]:
+    """Replay every registered IR case's budget avals into the recorder.
+
+    Only cores whose registered ``fn`` is an ``aot_seeded`` wrapper can be
+    cached (the wrapper's family string IS the serve-time lookup key);
+    plain jits in the registry are reported, not failed.
+    """
+    from citizensassemblies_tpu.aot.store import SeededJit
+    from citizensassemblies_tpu.lint.registry import build_cases
+
+    unwrapped: List[str] = []
+    recorded = 0
+    for name, case in build_cases():
+        if not isinstance(case.fn, SeededJit):
+            unwrapped.append(name)
+            continue
+        rec.record(case.fn, case.args, dict(case.static))
+        recorded += 1
+    return recorded, unwrapped
+
+
+def build_cache(
+    path: Optional[str] = None, profile: str = "smoke", cfg=None
+) -> Dict[str, Any]:
+    """Record, compile, serialize, save. Returns the build report."""
+    import jax
+    from jax.experimental.serialize_executable import serialize
+
+    cfg = coldboot_config(cfg)
+    path = resolve_cache_path(cfg, path)
+
+    # the package-level persistent XLA cache (citizensassemblies_tpu/
+    # __init__.py) can hand ``compile()`` an executable persisted by an
+    # EARLIER process under a different cpu runtime — its serialization
+    # then references JIT'd symbols no other process can resolve ("Symbols
+    # not found"). Serialized artifacts must come from THIS process's
+    # compiler, so the builder opts out of the disk cache.
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:  # pragma: no cover - cache knob absent/renamed
+        pass
+
+    # a store left installed by an earlier boot in this process would serve
+    # hits during recording — harmless for keys, but the build must compile
+    # from the inner jits, so blind the wrappers for the duration
+    install_store(None)
+    rec = Recorder()
+    install_recorder(rec)
+    t0 = time.time()
+    try:
+        manifest_n, unwrapped = _record_manifest(rec)
+        served = _record_flagship(cfg, profile)
+        lattice = bucket_lattice_workload(cfg, profile)
+    finally:
+        install_recorder(None)
+    record_s = time.time() - t0
+
+    entries: List[Dict[str, Any]] = []
+    skipped: List[Dict[str, str]] = []
+    t1 = time.time()
+    for (family, sig), spec in sorted(rec.entries.items()):
+        try:
+            lowered = spec["fn"].lower(*spec["lower_args"], **spec["lower_kwargs"])
+            donation = lowered.as_text().count("tf.aliasing_output")
+            payload, in_tree, out_tree = serialize(lowered.compile())
+        except Exception as exc:  # pallas/backend refusals: skip, keep going
+            skipped.append({"family": family, "sig": sig, "error": repr(exc)})
+            continue
+        entries.append(
+            {
+                "key": f"{family}|{sig}",
+                "family": family,
+                "sig": sig,
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+                "args": spec["args"],
+                "dyn_kwargs": spec["dyn_kwargs"],
+                "static_kwargs": {
+                    k: repr(v) for k, v in spec["static_kwargs"].items()
+                },
+                "donation": donation,
+            }
+        )
+    compile_s = time.time() - t1
+
+    report = {
+        "profile": profile,
+        "requests_served": served,
+        "manifest_cores_recorded": manifest_n,
+        "manifest_unwrapped": unwrapped,
+        "lattice_buckets": lattice["buckets"],
+        "entries": len(entries),
+        "skipped": skipped,
+        "families": sorted({e["family"] for e in entries}),
+        "record_s": round(record_s, 3),
+        "compile_serialize_s": round(compile_s, 3),
+        "path": os.path.abspath(path),
+    }
+    report["sha"] = save_artifact(path, entries, workload=dict(report))
+    return report

@@ -1,0 +1,569 @@
+"""graftboot executable store: AOT-serialized compiled cores, keyed by shape.
+
+The cold-start problem this kills: a fresh process pays seconds of XLA
+tracing + compilation per (core, bucket shape) before its first PDHG iterate
+runs. ``lint/ir.py`` already AOT-lowers every registered core
+(``lower().compile()``); this module closes the loop by SERIALIZING those
+compiled executables at build time (``jax.experimental.serialize_executable``)
+and loading them at boot, so the memo factories hand out programs that never
+touch the compiler.
+
+Three cooperating pieces:
+
+* :class:`SeededJit` — the wrapper every memo factory installs around its
+  jitted core (``aot_seeded``). Per call it computes a cheap shape/dtype
+  signature of the operands and consults the process ``ExecStore``: hit →
+  the deserialized executable runs (zero compile events, counted
+  ``aot_cache_hit``); miss → the original jit runs (counted
+  ``aot_cache_miss`` while a store is active). With no store installed the
+  wrapper is a pure pass-through, so ``Config.aot_cache=False`` is
+  bit-identical to the plain JIT path by construction. ``.lower`` delegates
+  to the inner jit — the IR/SPMD verifiers keep seeing the same program.
+* :class:`ExecStore` — the loaded cache: ``(family, call signature) →
+  deserialized executable`` plus the hit/miss/stale counters and the
+  artifact sha that land in bench rows and request audit stamps.
+* :class:`Recorder` — the build-time twin: while installed, every
+  ``SeededJit`` call records ``(family, inner jit, operand specs)`` so the
+  builder can re-lower each unique entry AT ITS EXACT SERVICE SIGNATURE
+  (weak types, donation, static values included) and serialize it. Recording
+  from the real call sites is what makes the cache key honest — no
+  hand-maintained shape manifest to drift.
+
+Staleness contract: the artifact is keyed by (jax version, backend,
+platform fingerprint, core family, shape/dtype/static signature, donation
+signature). A global fingerprint mismatch marks every entry stale at load; a
+per-entry deserialization failure or a call-time signature surprise falls
+back to the plain jit, counted (``aot_cache_stale``) — never a crash.
+
+Import-light by design: ``jax`` is imported lazily so the solver modules
+(which import ``aot_seeded`` at module top) pay nothing at import time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from citizensassemblies_tpu.utils.guards import compiling_as
+
+#: artifact schema — bump on any layout change; a mismatched artifact is
+#: treated as stale in toto (per-entry fallback, never a crash)
+SCHEMA_VERSION = 1
+
+_lock = threading.Lock()
+_STORE: Optional["ExecStore"] = None
+_RECORDER: Optional["Recorder"] = None
+
+
+# --- call signatures ---------------------------------------------------------
+
+
+def _spec_of(value: Any) -> Tuple[str, Any]:
+    """One operand's cache-key spec: arrays by (shape, dtype, weak_type),
+    python scalars by their aval CLASS (a weak f32 scalar compiles the same
+    executable whatever its value), everything else by repr."""
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is not None and dtype is not None:
+        weak = bool(getattr(value, "weak_type", False))
+        return ("arr", (tuple(int(d) for d in shape), str(dtype), weak))
+    if isinstance(value, bool):
+        return ("pybool", value)
+    if isinstance(value, int):
+        return ("pyint", 0)
+    if isinstance(value, float):
+        return ("pyfloat", 0.0)
+    return ("lit", repr(value))
+
+
+def _sig_token(spec: Tuple[str, Any]) -> str:
+    kind, payload = spec
+    if kind == "arr":
+        shape, dtype, weak = payload
+        return f"{dtype}{list(shape)}{'w' if weak else ''}"
+    if kind == "pybool":
+        return f"b{int(payload)}"
+    return kind if kind in ("pyint", "pyfloat") else f"={payload}"
+
+
+def call_signature(
+    args: Sequence[Any],
+    kwargs: Dict[str, Any],
+    static_argnames: Sequence[str] = (),
+) -> str:
+    """The store-lookup key fragment for one call: dynamic operands by
+    shape/dtype signature, static kwargs by value (a static changes the
+    compiled program, so it is part of the key)."""
+    parts: List[str] = []
+    for a in args:
+        parts.append(_sig_token(_spec_of(a)))
+    for name in sorted(kwargs):
+        v = kwargs[name]
+        if name in static_argnames:
+            parts.append(f"{name}={v!r}")
+        else:
+            parts.append(f"{name}:{_sig_token(_spec_of(v))}")
+    return ";".join(parts)
+
+
+# --- platform fingerprint ----------------------------------------------------
+
+
+def platform_fingerprint() -> Dict[str, Any]:
+    """The environment identity a serialized executable is only valid for:
+    jax version, backend, device platform/kind/count. Loaded against a
+    different fingerprint, every entry is stale (JIT fallback, counted)."""
+    import jax
+
+    dev = jax.devices()[0]
+    fp = {
+        "schema": SCHEMA_VERSION,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "platform": str(getattr(dev, "platform", "?")),
+        "device_kind": str(getattr(dev, "device_kind", "?")),
+        "device_count": int(jax.device_count()),
+    }
+    if fp["backend"] == "cpu":
+        # XLA:CPU's thunk runtime emits executables whose JIT'd symbols do
+        # not survive cross-process deserialization ("Symbols not found");
+        # CPU caches are built and loaded under the legacy runtime
+        # (XLA_FLAGS=--xla_cpu_use_thunk_runtime=false, see Makefile
+        # aot-cache). The runtime choice is part of the artifact identity.
+        fp["cpu_runtime"] = (
+            "legacy"
+            if "--xla_cpu_use_thunk_runtime=false"
+            in os.environ.get("XLA_FLAGS", "")
+            else "thunk"
+        )
+    return fp
+
+
+def default_cache_path() -> str:
+    """Resolution order: ``CITIZENS_AOT_CACHE`` env override, else a
+    per-user cache file. The backend rides the filename so a TPU build and
+    a CPU build never collide."""
+    env = os.environ.get("CITIZENS_AOT_CACHE", "")
+    if env:
+        return env
+    import jax
+
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "citizensassemblies_tpu",
+        f"aot_cache_{jax.default_backend()}.pkl",
+    )
+
+
+def resolve_cache_path(cfg=None, path: Optional[str] = None) -> str:
+    if path:
+        return str(path)
+    cfg_path = str(getattr(cfg, "aot_cache_path", "") or "") if cfg is not None else ""
+    return cfg_path or default_cache_path()
+
+
+# --- the loaded store --------------------------------------------------------
+
+
+class ExecStore:
+    """The boot-loaded executable cache plus its serving counters.
+
+    ``lookup`` and the counters are thread-safe (serving dispatches from
+    several request workers at once); entries are immutable after load.
+    """
+
+    def __init__(self, sha: str, status: str = "ok"):
+        self.sha = sha
+        #: "ok" | "missing" | "corrupt" | "fingerprint_mismatch"
+        self.status = status
+        #: raw serialized payloads — deserialization is LAZY (first lookup),
+        #: so boot pays only for the entries it actually serves and a bad
+        #: payload surfaces exactly where the jit fallback lives
+        self._raw: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._entries: Dict[Tuple[str, str], Any] = {}
+        self._dead: set = set()
+        #: per-entry operand specs (family → list of (args specs, kwargs)),
+        #: what the speculative pre-warm replays with inert zero operands
+        self._specs: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._clock = threading.Lock()
+        self._mlock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.stale = 0
+        self.prewarmed = 0
+
+    def __len__(self) -> int:
+        return len(self._raw) + sum(
+            1 for k in self._entries if k not in self._raw
+        )
+
+    def families(self) -> List[str]:
+        keys = set(self._raw) | set(self._entries)
+        return sorted({fam for fam, _sig in keys})
+
+    def add(self, family: str, sig: str, exe: Any, spec: Dict[str, Any]) -> None:
+        """Install an already-loaded executable (tests, eager loads)."""
+        self._entries[(family, sig)] = exe
+        self._specs[(family, sig)] = spec
+
+    def add_raw(self, family: str, sig: str, raw: Dict[str, Any]) -> None:
+        """Install a serialized entry for lazy deserialization at first use."""
+        self._raw[(family, sig)] = raw
+        self._specs[(family, sig)] = {
+            "args": raw.get("args", []),
+            "dyn_kwargs": raw.get("dyn_kwargs", []),
+        }
+
+    def _materialize(self, key: Tuple[str, str]) -> Optional[Any]:
+        exe = self._entries.get(key)
+        if exe is not None:
+            return exe
+        raw = self._raw.get(key)
+        if raw is None or key in self._dead:
+            return None
+        with self._mlock:
+            exe = self._entries.get(key)
+            if exe is not None or key in self._dead:
+                return exe
+            try:
+                from jax.experimental.serialize_executable import (
+                    deserialize_and_load,
+                )
+
+                exe = deserialize_and_load(
+                    raw["payload"], raw["in_tree"], raw["out_tree"]
+                )
+            except Exception:
+                self._dead.add(key)
+                self.bump_stale()
+                return None
+            self._entries[key] = exe
+            return exe
+
+    def lookup(self, family: str, sig: str) -> Optional[Any]:
+        exe = self._materialize((family, sig))
+        with self._clock:
+            if exe is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+        return exe
+
+    def bump_stale(self, n: int = 1) -> None:
+        with self._clock:
+            self.stale += int(n)
+
+    def unhit(self) -> None:
+        """A looked-up executable that failed at call time: re-book the hit
+        as stale (the fallback jit serves the request)."""
+        with self._clock:
+            self.hits -= 1
+            self.stale += 1
+
+    def stamp(self) -> Dict[str, Any]:
+        """The ``aot`` block for bench rows and request audit stamps."""
+        with self._clock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "stale": self.stale,
+                "prewarmed": self.prewarmed,
+                "entries": len(self),
+                "cache_sha": self.sha,
+                "status": self.status,
+            }
+
+    # --- speculative pre-warm ------------------------------------------------
+
+    def prewarm(
+        self,
+        families: Optional[Sequence[str]] = None,
+        nv_max: Optional[int] = None,
+    ) -> int:
+        """Touch loaded executables with inert all-zero operands.
+
+        Padding lanes in this codebase are inert by construction (an
+        all-zero LP instance's KKT residual is 0 at the first convergence
+        check), so executing an entry on zeros costs one cheap dispatch and
+        faults in every lazy buffer the first real solve would otherwise
+        pay for. ``families`` filters by family-name prefix; ``nv_max``
+        drops entries whose widest operand axis exceeds the predicted
+        bucket dimension (the registry-fingerprint → bucket-shape map).
+        Failures are ignored — pre-warming is speculative by definition.
+        """
+        import jax.numpy as jnp
+
+        touched = 0
+        keys = sorted(set(self._raw) | set(self._entries))
+        for family, sig in keys:
+            if families is not None and not any(
+                family.startswith(p) for p in families
+            ):
+                continue
+            exe = self._materialize((family, sig))
+            if exe is None:
+                continue
+            spec = self._specs.get((family, sig)) or {}
+            arg_specs = spec.get("args", [])
+            if nv_max is not None:
+                widest = max(
+                    (max(s[1][0]) for s in arg_specs if s[0] == "arr" and s[1][0]),
+                    default=0,
+                )
+                if widest > int(nv_max):
+                    continue
+            try:
+                operands = []
+                for kind, payload in arg_specs:
+                    if kind == "arr":
+                        shape, dtype, _weak = payload
+                        operands.append(jnp.zeros(shape, dtype))
+                    elif kind == "pybool":
+                        operands.append(bool(payload))
+                    elif kind == "pyint":
+                        operands.append(0)
+                    elif kind == "pyfloat":
+                        operands.append(1.0)
+                    else:  # unreplayable literal: skip the entry
+                        raise TypeError(payload)
+                for name, nspec in spec.get("dyn_kwargs", []):
+                    kind, payload = nspec
+                    if kind != "arr":
+                        raise TypeError(name)
+                    shape, dtype, _weak = payload
+                    operands.append(jnp.zeros(shape, dtype))
+                exe(*operands)
+            except Exception:
+                continue
+            touched += 1
+        with self._clock:
+            self.prewarmed += touched
+        return touched
+
+
+def install_store(store: Optional[ExecStore]) -> None:
+    """Install (or clear, with ``None``) the process-global store the
+    ``SeededJit`` wrappers consult."""
+    global _STORE
+    with _lock:
+        _STORE = store
+
+
+def active_store() -> Optional[ExecStore]:
+    return _STORE
+
+
+# --- the seeded-jit wrapper --------------------------------------------------
+
+
+def _ambient_gate_off() -> bool:
+    """True when the ambient request's config hard-disables the cache
+    (``Config.aot_cache=False`` must be bit-identical AND store-blind even
+    while another tenant's store is installed)."""
+    try:
+        from citizensassemblies_tpu.service.context import current_context
+    except Exception:  # pragma: no cover - service layer absent
+        return False
+    ctx = current_context()
+    return ctx is not None and getattr(ctx.cfg, "aot_cache", None) is False
+
+
+class SeededJit:
+    """A memo factory's jitted core, store-seeded (see module docstring).
+
+    ``family`` carries the core id AND its static schedule key (the factory
+    builds one wrapper per key, so the family string is unique per compiled
+    program family). ``static_argnames`` mirrors the inner jit's statics —
+    the wrapper needs them to key static kwargs by VALUE and to drop them
+    from the deserialized call (an AOT executable takes dynamic operands
+    only; its statics are baked in).
+    """
+
+    __slots__ = ("family", "fn", "static_argnames")
+
+    def __init__(self, family: str, fn: Any, static_argnames: Sequence[str] = ()):
+        self.family = family
+        self.fn = fn
+        self.static_argnames = tuple(static_argnames)
+
+    def __call__(self, *args, **kwargs):
+        rec = _RECORDER
+        if rec is not None:
+            rec.record(self, args, kwargs)
+        store = _STORE
+        if store is not None and not _ambient_gate_off():
+            sig = call_signature(args, kwargs, self.static_argnames)
+            exe = store.lookup(self.family, sig)
+            if exe is not None:
+                dyn_kwargs = {
+                    k: v for k, v in kwargs.items()
+                    if k not in self.static_argnames
+                }
+                try:
+                    with compiling_as(self.family):
+                        return exe(*args, **dyn_kwargs)
+                except Exception:
+                    # signature surprise (donation/layout/aval drift): the
+                    # plain jit serves the call — stale, never a crash
+                    store.unhit()
+        with compiling_as(self.family):
+            return self.fn(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        """The IR/SPMD verifiers' entry point — always the inner jit."""
+        return self.fn.lower(*args, **kwargs)
+
+
+def aot_seeded(family: str, fn: Any, static_argnames: Sequence[str] = ()) -> SeededJit:
+    """Wrap a freshly built jitted core for store seeding (factory exit)."""
+    return SeededJit(family, fn, static_argnames)
+
+
+# --- build-time recording ----------------------------------------------------
+
+
+class Recorder:
+    """Collects ``(family, inner jit, operand specs)`` from live SeededJit
+    calls while installed — the builder's shape manifest (see build.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: (family, sig) → {"fn", "args" specs, "static_kwargs",
+        #: "dyn_kwargs", "lower_args", "lower_kwargs"}
+        self.entries: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+    def record(self, seeded: SeededJit, args, kwargs) -> None:
+        import jax
+
+        sig = call_signature(args, kwargs, seeded.static_argnames)
+        key = (seeded.family, sig)
+        with self._lock:
+            if key in self.entries:
+                return
+            lower_args = []
+            arg_specs = []
+            for a in args:
+                spec = _spec_of(a)
+                arg_specs.append(spec)
+                if spec[0] == "arr":
+                    shape, dtype, weak = spec[1]
+                    lower_args.append(
+                        jax.ShapeDtypeStruct(shape, dtype, weak_type=weak)
+                    )
+                else:
+                    lower_args.append(a)
+            static_kwargs = {}
+            dyn_kwargs = []
+            lower_kwargs = {}
+            for name, v in kwargs.items():
+                if name in seeded.static_argnames:
+                    static_kwargs[name] = v
+                    lower_kwargs[name] = v
+                else:
+                    spec = _spec_of(v)
+                    dyn_kwargs.append((name, spec))
+                    if spec[0] == "arr":
+                        shape, dtype, weak = spec[1]
+                        lower_kwargs[name] = jax.ShapeDtypeStruct(
+                            shape, dtype, weak_type=weak
+                        )
+                    else:
+                        lower_kwargs[name] = v
+            self.entries[key] = {
+                "fn": seeded.fn,
+                "args": arg_specs,
+                "static_kwargs": static_kwargs,
+                "dyn_kwargs": sorted(dyn_kwargs),
+                "lower_args": lower_args,
+                "lower_kwargs": lower_kwargs,
+            }
+
+
+def install_recorder(rec: Optional[Recorder]) -> None:
+    global _RECORDER
+    with _lock:
+        _RECORDER = rec
+
+
+# --- artifact save / load ----------------------------------------------------
+
+
+def _artifact_sha(entries: List[Dict[str, Any]]) -> str:
+    h = hashlib.sha256()
+    for e in sorted(entries, key=lambda e: e["key"]):
+        h.update(e["key"].encode())
+        h.update(e["payload"])
+    return h.hexdigest()[:12]
+
+
+def save_artifact(
+    path: str,
+    entries: List[Dict[str, Any]],
+    workload: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write the versioned cache artifact; returns its content sha."""
+    sha = _artifact_sha(entries)
+    doc = {
+        "schema_version": SCHEMA_VERSION,
+        "fingerprint": platform_fingerprint(),
+        "sha": sha,
+        "workload": dict(workload or {}),
+        "entries": entries,
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        pickle.dump(doc, fh, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    return sha
+
+
+def load_store(
+    path: Optional[str] = None, cfg=None, require: bool = False
+) -> Optional[ExecStore]:
+    """Load + deserialize the cache artifact into an :class:`ExecStore`.
+
+    Failure ladder (``require=False``): missing file → ``None``; unreadable
+    or schema/fingerprint-mismatched artifact → an EMPTY store whose status
+    records why (so the miss/stale counters still ride the audit stamps);
+    per-entry deserialization is LAZY — a bad payload surfaces at its first
+    lookup, counted stale, and the plain jit serves that call. With
+    ``require=True`` (``Config.aot_cache=True``) the first two rungs raise
+    instead — the fail-loud mode for fleets that must not boot cold.
+    """
+    path = resolve_cache_path(cfg, path)
+    if not os.path.exists(path):
+        if require:
+            raise RuntimeError(
+                f"aot_cache=True but no cache artifact at {path} — run "
+                "`python -m citizensassemblies_tpu.aot build` (make aot-cache)"
+            )
+        return None
+    try:
+        with open(path, "rb") as fh:
+            doc = pickle.load(fh)
+        entries = doc["entries"]
+        fingerprint = doc["fingerprint"]
+        sha = doc["sha"]
+        if doc["schema_version"] != SCHEMA_VERSION:
+            raise ValueError(f"schema {doc['schema_version']} != {SCHEMA_VERSION}")
+    except Exception as exc:
+        if require:
+            raise RuntimeError(f"aot_cache=True but {path} is unreadable: {exc}")
+        return ExecStore(sha="", status="corrupt")
+    mine = platform_fingerprint()
+    if fingerprint != mine:
+        if require:
+            raise RuntimeError(
+                f"aot_cache=True but {path} was built for {fingerprint}, "
+                f"this process is {mine}"
+            )
+        store = ExecStore(sha=sha, status="fingerprint_mismatch")
+        store.bump_stale(len(entries))
+        return store
+    store = ExecStore(sha=sha)
+    for e in entries:
+        store.add_raw(e["family"], e["sig"], e)
+    return store

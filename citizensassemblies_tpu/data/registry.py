@@ -487,10 +487,18 @@ def churn_trail(
                         kind="quota_tighten", cell=f, dlo=dlo, dhi=dhi
                     )
             if kind == "quota_relax":
-                dlo = -1 if lo > 0 else 0
-                dhi = 1 if hi < cur.k else 0
-                if dlo == 0 and dhi == 0:
+                # exactly ONE arm per edit: a relax that widened both bounds
+                # at once is a 2-unit step — outside the single-unit edit
+                # grammar every consumer (delta re-certifier sensitivity,
+                # trail replays) is sized for. Both arms open → rng picks.
+                arms = []
+                if lo > 0:
+                    arms.append((-1, 0))
+                if hi < cur.k:
+                    arms.append((0, 1))
+                if not arms:
                     continue
+                dlo, dhi = arms[int(rng.integers(0, len(arms)))]
                 edit = RegistryEdit(kind="quota_relax", cell=f, dlo=dlo, dhi=dhi)
         elif kind == "new_type":
             c = int(rng.integers(0, cur.n_categories))

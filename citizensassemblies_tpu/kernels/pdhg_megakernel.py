@@ -53,6 +53,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from citizensassemblies_tpu.aot.store import aot_seeded
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.config import Config, default_config
@@ -587,11 +588,15 @@ def _mk_two_sided_body(
 
 # same donation contract as the chained batched core (x0, lam0; mu0 stays
 # undonated for layout parity with _pdhg_two_sided_core_ell)
-two_sided_megakernel_core = partial(
-    jax.jit,
+two_sided_megakernel_core = aot_seeded(
+    "kernels.megakernel_two_sided",
+    partial(
+        jax.jit,
+        static_argnames=("max_iters", "check_every", "sentinel", "interpret"),
+        donate_argnums=(4, 5),
+    )(_mk_two_sided_body),
     static_argnames=("max_iters", "check_every", "sentinel", "interpret"),
-    donate_argnums=(4, 5),
-)(_mk_two_sided_body)
+)
 
 
 def dispatch_two_sided(
@@ -959,11 +964,15 @@ def _mk_lp_body(
     return x_out, lam_out, mu_out, it, res
 
 
-lp_megakernel_core = partial(
-    jax.jit,
+lp_megakernel_core = aot_seeded(
+    "kernels.megakernel_lp",
+    partial(
+        jax.jit,
+        static_argnames=("max_iters", "check_every", "sentinel", "interpret"),
+        donate_argnums=(6, 7, 8),  # x0, lam0, mu0 — the chained-core contract
+    )(_mk_lp_body),
     static_argnames=("max_iters", "check_every", "sentinel", "interpret"),
-    donate_argnums=(6, 7, 8),  # x0, lam0, mu0 — the chained-core contract
-)(_mk_lp_body)
+)
 
 
 def dispatch_lp(

@@ -228,6 +228,15 @@ def collect() -> List[CoreEntry]:
     ]
 
 
+def build_cases() -> List[Tuple[str, IRCase]]:
+    """``(name, built IRCase)`` for every registered core — the shape
+    manifest graftboot's cache builder replays: each case's example avals
+    are exactly the budget shapes the IR pass certifies, so recording them
+    through the ``aot_seeded`` wrappers seeds the executable cache with
+    every core the verifier knows about (``aot/build.py``)."""
+    return [(entry.name, entry.build()) for entry in collect()]
+
+
 def collect_spmd() -> List[SpmdEntry]:
     """Import every MANIFEST module and return the mesh-parameterized SPMD
     registrations, sorted — the cores graftspmd sweeps across virtual mesh

@@ -111,6 +111,11 @@ METRIC_SERIES: Dict[str, str] = {
     # --- graftscope memory ledger (obs/memory.py) ------------------------
     "mem_live_bytes": "bytes held by live jax arrays at the last ledger snapshot",
     "mem_hbm_peak_bytes": "device-memory high watermark over the ledger's window",
+    # --- graftboot AOT executable cache (aot/) ---------------------------
+    "aot_cache_hit": "core dispatches served by a boot-loaded AOT executable (zero compiles)",
+    "aot_cache_miss": "core dispatches at signatures the cache artifact does not hold",
+    "aot_cache_stale": "cache entries invalidated at load or at first use (fingerprint, payload, call surprise)",
+    "aot_prewarmed": "cached executables touched by speculative pre-warming (boot fleet + tenant admission)",
     # --- solver phase timers ---------------------------------------------
     "relax_leximin": "leximin relaxation phase (timer)",
     "inject": "fault-injection bookkeeping phase (timer)",

@@ -50,6 +50,7 @@ _SERVE_RE = re.compile(r"BENCH_serve_r(\d+)\.json$")
 _KERNELS_RE = re.compile(r"BENCH_kernels_r(\d+)\.json$")
 _ROOFLINE_RE = re.compile(r"ROOFLINE_r(\d+)\.json$")
 _CHURN_RE = re.compile(r"BENCH_churn_r(\d+)\.json$")
+_COLDBOOT_RE = re.compile(r"BENCH_coldboot_r(\d+)\.json$")
 
 
 @dataclasses.dataclass
@@ -209,6 +210,14 @@ def collect_series(root) -> Tuple[Dict[str, List[Tuple[int, float]]], List[int]]
         # graftdelta churn family (bench.py --churn): per-edit-class delta
         # medians + the sampled from-scratch arm, same detail schema
         m = _CHURN_RE.search(path.name)
+        if m:
+            rows = _load_offline(path)
+            if rows:
+                by_round.setdefault(int(m.group(1)), {}).update(rows)
+    for path in sorted(root.glob("BENCH_coldboot_r*.json")):
+        # graftboot coldboot family (bench.py --coldboot): fresh-process
+        # boot-to-first-certified-result wall clock, cached vs uncached
+        m = _COLDBOOT_RE.search(path.name)
         if m:
             rows = _load_offline(path)
             if rows:

@@ -325,9 +325,18 @@ def _get_sharded_jit(mesh: Mesh, block_iters: int, max_blocks: int):
     key = (mesh, axes, "dense", block_iters, max_blocks)
     core = _CORE_CACHE.get(key)
     if core is None:
-        core = jax.jit(
-            _sharded_core(mesh, axes, block_iters, max_blocks),
-            donate_argnums=(1,),
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        # the family string carries the mesh IDENTITY (device count + axis
+        # names): a serialized executable is sharding-specific, so a cache
+        # built on one mesh must miss cleanly on another
+        core = aot_seeded(
+            f"parallel.sharded[{len(mesh.devices.flat)}x{','.join(axes)},"
+            f"{block_iters},{max_blocks}]",
+            jax.jit(
+                _sharded_core(mesh, axes, block_iters, max_blocks),
+                donate_argnums=(1,),
+            ),
         )
         _CORE_CACHE[key] = core
     return core
@@ -341,9 +350,15 @@ def _get_sharded_jit_ell(mesh: Mesh, block_iters: int, max_blocks: int):
     key = (mesh, axes, "ell", block_iters, max_blocks)
     core = _CORE_CACHE.get(key)
     if core is None:
-        core = jax.jit(
-            _sharded_core_ell(mesh, axes, block_iters, max_blocks),
-            donate_argnums=(2,),
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        core = aot_seeded(
+            f"parallel.sharded_ell[{len(mesh.devices.flat)}x{','.join(axes)},"
+            f"{block_iters},{max_blocks}]",
+            jax.jit(
+                _sharded_core_ell(mesh, axes, block_iters, max_blocks),
+                donate_argnums=(2,),
+            ),
         )
         _CORE_CACHE[key] = core
     return core

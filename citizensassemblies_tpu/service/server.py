@@ -241,6 +241,19 @@ class SelectionService:
             from citizensassemblies_tpu.obs.slo import SloEngine
 
             self.slo = SloEngine(slo_spec)
+        # --- graftboot AOT executable cache (aot/) -------------------------
+        #: the boot-loaded executable store. Tri-state Config.aot_cache:
+        #: None loads a cache when one exists (missing → None, serve JIT),
+        #: True fails HERE, at construction, when the artifact is absent or
+        #: mismatched (fleets that must not boot cold), False never loads.
+        #: submit() speculatively pre-warms it on each tenant's first
+        #: admission; _finish() stamps its counters on every audit.
+        self.aot_store = None
+        if getattr(self.cfg, "aot_cache", None) is not False:
+            from citizensassemblies_tpu.aot import boot
+
+            self.aot_store = boot(self.cfg)
+        self._prewarmed_tenants: set = set()
 
     # --- public API ---------------------------------------------------------
 
@@ -271,6 +284,7 @@ class SelectionService:
         with self._lock:
             self._channels[rid] = channel
         self._ensure_snapshot_loop()
+        self._maybe_prewarm(request.tenant, cfg)
         # the submission timestamp rides into the worker so the sojourn
         # decomposition can attribute queue wait (worker pickup − submit)
         fut = self._pool.submit(
@@ -283,6 +297,28 @@ class SelectionService:
     def run(self, request: SelectionRequest, timeout: Optional[float] = None):
         """Convenience: submit and block for the result."""
         return self.submit(request).result(timeout=timeout)
+
+    def _maybe_prewarm(self, tenant: str, cfg: Config) -> None:
+        """Speculative bucket pre-warm on a tenant's FIRST admission: touch
+        the cached batch-LP bucket executables off-thread so the buffers the
+        tenant's solves will fault in are resident before its request leaves
+        the queue. Tri-state ``Config.aot_prewarm``: None warms whenever a
+        store is loaded, False never, True is reserved for boot-time eager
+        warming (the coldboot bench child). Speculative by definition —
+        failures are swallowed by ``ExecStore.prewarm`` itself."""
+        store = self.aot_store
+        if store is None or getattr(cfg, "aot_prewarm", None) is False:
+            return
+        with self._lock:
+            if tenant in self._prewarmed_tenants:
+                return
+            self._prewarmed_tenants.add(tenant)
+        threading.Thread(
+            target=store.prewarm,
+            kwargs={"families": ("batch_lp.",)},
+            name=f"graftboot-prewarm-{tenant}",
+            daemon=True,
+        ).start()
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -357,6 +393,26 @@ class SelectionService:
                 help="LRU evictions attributed per owner",
                 labelnames=("owner",),
             ).labels(owner=owner).set(n)
+        # graftboot store counters (cumulative process gauges): how much of
+        # the fleet's dispatch is riding pre-compiled executables
+        if self.aot_store is not None:
+            aot = self.aot_store.stamp()
+            m.gauge(
+                "aot_cache_hit",
+                help="dispatches served by boot-loaded AOT executables",
+            ).set(aot["hits"])
+            m.gauge(
+                "aot_cache_miss",
+                help="dispatches at signatures the cache does not hold",
+            ).set(aot["misses"])
+            m.gauge(
+                "aot_cache_stale",
+                help="cache entries invalidated at load or first use",
+            ).set(aot["stale"])
+            m.gauge(
+                "aot_prewarmed",
+                help="executables touched by speculative pre-warming",
+            ).set(aot["prewarmed"])
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Structured fleet snapshot: the typed registry plus the raw
@@ -1031,6 +1087,10 @@ class SelectionService:
                 "batch_window_s": round(min(batch_window, solve), 4),
                 "audit_s": round(max(now - t_x1, 0.0), 4),
             }
+        # graftboot: the executable store's serving counters — how much of
+        # this process's dispatch is riding pre-compiled executables
+        if self.aot_store is not None:
+            audit["aot"] = self.aot_store.stamp()
         # graftscope memory ledger: the request's device-memory summary
         if ledger is not None:
             ledger.snapshot("request_end")

@@ -238,7 +238,12 @@ def _get_batch_core(max_iters: int, check_every: int, sentinel: bool = False):
         one = partial(
             _pdhg_body, max_iters=key[0], check_every=key[1], sentinel=key[2]
         )
-        core = jax.jit(jax.vmap(one), donate_argnums=(5, 6, 7))
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        core = aot_seeded(
+            f"batch_lp.vmapped[{key[0]},{key[1]},{int(key[2])}]",
+            jax.jit(jax.vmap(one), donate_argnums=(5, 6, 7)),
+        )
         _BATCH_CORES[key] = core
     return core
 
@@ -621,9 +626,15 @@ def _get_polish_screen_ell_core(
             _pdhg_two_sided_body_ell, max_iters=key[0], check_every=key[1],
             sentinel=key[2],
         )
-        core = jax.jit(
-            jax.vmap(one, in_axes=(None, None, None, 0, 0, 0, 0, 0)),
-            donate_argnums=(4, 5),  # stacked x0/lam0 (mu0 scalar lanes stay)
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        core = aot_seeded(
+            f"batch_lp.polish_ell[{key[0]},{key[1]},{int(key[2])}]",
+            jax.jit(
+                jax.vmap(one, in_axes=(None, None, None, 0, 0, 0, 0, 0)),
+                # stacked x0/lam0 (mu0 scalar lanes stay)
+                donate_argnums=(4, 5),
+            ),
         )
         _POLISH_ELL_CORES[key] = core
     return core

@@ -305,7 +305,11 @@ def _get_screen_core():
             gap = mu[:, None] - price  # [S, C]
             return feas, gap
 
-        _SCREEN_CORE = core
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        _SCREEN_CORE = aot_seeded(
+            "delta.screen", core, static_argnames=("k",)
+        )
     return _SCREEN_CORE
 
 

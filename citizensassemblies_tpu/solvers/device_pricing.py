@@ -188,7 +188,11 @@ def _get_greedy_core():
 
             return jax.vmap(lane)(weights, forced)
 
-        _GREEDY_CORE = core
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        _GREEDY_CORE = aot_seeded(
+            "device_pricing.greedy", core, static_argnames=("k",)
+        )
     return _GREEDY_CORE
 
 
@@ -247,7 +251,11 @@ def _get_dp_core():
 
             return jax.vmap(lane)(weights, forced)
 
-        _DP_CORE = core
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        _DP_CORE = aot_seeded(
+            "device_pricing.dp", core, static_argnames=("k",)
+        )
     return _DP_CORE
 
 

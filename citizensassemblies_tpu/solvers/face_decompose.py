@@ -174,7 +174,11 @@ def _get_move_screen_core():
             (idx,) = jnp.nonzero(flat, size=cap, fill_value=-1)
             return idx.astype(jnp.int32), flat.sum(dtype=jnp.int32)
 
-        _MOVE_SCREEN_CORE = core
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        _MOVE_SCREEN_CORE = aot_seeded(
+            "face_decompose.move_screen", core, static_argnames=("cap",)
+        )
     return _MOVE_SCREEN_CORE
 
 
@@ -239,7 +243,12 @@ def _get_fused_screen_core():
             (idx,) = jnp.nonzero(flat, size=cap, fill_value=-1)
             return idx.astype(jnp.int32), flat.sum(dtype=jnp.int32), ti, tj
 
-        _FUSED_SCREEN_CORE = core
+        from citizensassemblies_tpu.aot.store import aot_seeded
+
+        _FUSED_SCREEN_CORE = aot_seeded(
+            "face_decompose.fused_screen", core,
+            static_argnames=("cap", "pool_cap", "face_pairs"),
+        )
     return _FUSED_SCREEN_CORE
 
 

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from citizensassemblies_tpu.aot.store import aot_seeded
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.config import Config, default_config
@@ -335,11 +336,15 @@ def _pdhg_body(
 # undecorated ``_pdhg_body`` stays importable so the batched engine
 # (``solvers/batch_lp.py``) can ``vmap`` the IDENTICAL iteration over a
 # padded instance bucket — one math definition, two dispatch shapes.
-_pdhg_core = partial(
-    jax.jit,
+_pdhg_core = aot_seeded(
+    "lp_pdhg.pdhg_core",
+    partial(
+        jax.jit,
+        static_argnames=("max_iters", "check_every", "sentinel"),
+        donate_argnums=(5, 6, 7),
+    )(_pdhg_body),
     static_argnames=("max_iters", "check_every", "sentinel"),
-    donate_argnums=(5, 6, 7),
-)(_pdhg_body)
+)
 
 
 def solve_lp(
@@ -667,6 +672,13 @@ def _pdhg_two_sided_core(
     return x_out, lam_out, mu_out, it, res
 
 
+_pdhg_two_sided_core = aot_seeded(
+    "lp_pdhg.two_sided_core",
+    _pdhg_two_sided_core,
+    static_argnames=("max_iters", "check_every", "sentinel"),
+)
+
+
 def _pdhg_two_sided_body_ell(
     idx, val, v, colmask, x0, lam0, mu0, tol, max_iters: int, check_every: int,
     sentinel: bool = False,
@@ -765,11 +777,16 @@ def _pdhg_two_sided_body_ell(
 
 # the undecorated body stays importable so the batched polish screen can
 # ``vmap`` the identical ELL iteration over prefix lanes (solvers/batch_lp)
-_pdhg_two_sided_core_ell = partial(
-    jax.jit,
+_pdhg_two_sided_core_ell = aot_seeded(
+    "lp_pdhg.two_sided_core_ell",
+    partial(
+        jax.jit,
+        static_argnames=("max_iters", "check_every", "sentinel"),
+        # x0, lam0 (mu0 is a scalar, undonated by design)
+        donate_argnums=(4, 5),
+    )(_pdhg_two_sided_body_ell),
     static_argnames=("max_iters", "check_every", "sentinel"),
-    donate_argnums=(4, 5),  # x0, lam0 (mu0 is a scalar, undonated by design)
-)(_pdhg_two_sided_body_ell)
+)
 
 
 @dataclasses.dataclass
@@ -1202,11 +1219,15 @@ def _pdhg_body_ell(
     return x * d_c, lam * d_r[:m1], mu * d_r[m1:], it, res
 
 
-_pdhg_core_ell = partial(
-    jax.jit,
+_pdhg_core_ell = aot_seeded(
+    "lp_pdhg.pdhg_core_ell",
+    partial(
+        jax.jit,
+        static_argnames=("max_iters", "check_every", "sentinel"),
+        donate_argnums=(6, 7, 8),  # x0, lam0, mu0 — same carry contract
+    )(_pdhg_body_ell),
     static_argnames=("max_iters", "check_every", "sentinel"),
-    donate_argnums=(6, 7, 8),  # x0, lam0, mu0 — same carry contract
-)(_pdhg_body_ell)
+)
 
 
 def solve_lp_ell(

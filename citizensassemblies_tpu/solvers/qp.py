@@ -253,6 +253,11 @@ def _get_l2_fused_core(
         lam, p, k, _delta = jax.lax.while_loop(cond, block, state0)
         return p, p_floor, it_eps, k * chunk
 
+    from citizensassemblies_tpu.aot.store import aot_seeded
+
+    fused = aot_seeded(
+        "qp.l2_fused[" + ",".join(str(int(v)) for v in key) + "]", fused
+    )
     _L2_FUSED_CORES[key] = fused
     return fused
 
@@ -373,6 +378,11 @@ def _get_l2_fused_core_ell(
         lam, p, k, _delta = jax.lax.while_loop(cond, block, state0)
         return p, p_floor, it_eps, k * chunk
 
+    from citizensassemblies_tpu.aot.store import aot_seeded
+
+    fused = aot_seeded(
+        "qp.l2_fused_ell[" + ",".join(str(int(v)) for v in key) + "]", fused
+    )
     _L2_FUSED_CORES_ELL[key] = fused
     return fused
 

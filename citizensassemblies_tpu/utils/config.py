@@ -522,6 +522,30 @@ class Config:
     #: for a violation is to materialize the operand once, outside the loop.
     transfer_guard: str = "disallow"
 
+    # --- graftboot AOT executable cache (aot/) --------------------------------
+    #: tri-state cold-start killer: boot the process from the serialized
+    #: executable cache (``make aot-cache``) so the memo factories hand out
+    #: pre-compiled programs. ``None`` (auto) = load the artifact if one
+    #: exists, boot cold otherwise; ``True`` = required — a missing,
+    #: unreadable or fingerprint-mismatched artifact raises at boot (the
+    #: fleet mode where a cold boot is an incident); ``False`` = hard off,
+    #: bit-identical to the plain JIT path (pinned by test). At serve time
+    #: a per-entry mismatch always falls back to JIT, counted
+    #: (``aot_cache_hit/miss/stale``), never a crash.
+    aot_cache: Optional[bool] = None
+    #: cache artifact path override; "" resolves ``CITIZENS_AOT_CACHE`` then
+    #: the per-user default (``~/.cache/citizensassemblies_tpu/``, keyed by
+    #: backend so CPU and TPU artifacts never collide).
+    aot_cache_path: str = ""
+    #: speculative bucket pre-warm on tenant admission: map the new tenant's
+    #: first instance to its predicted LP bucket shapes and touch those
+    #: cached executables with inert zero operands (padding lanes converge
+    #: at the first KKT check, so a touch costs one cheap dispatch) before
+    #: the first real solve lands. ``None`` = auto (on whenever a cache is
+    #: installed); ``False`` off; ``True`` additionally warms eagerly even
+    #: when the store booted empty (a no-op, kept for symmetry).
+    aot_prewarm: Optional[bool] = None
+
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
 

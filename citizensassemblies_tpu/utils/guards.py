@@ -39,9 +39,43 @@ _lock = threading.Lock()
 _active_guards: List["CompilationGuard"] = []
 _listener_installed = False
 
+#: thread-local core-label stack — backend_compile events emit synchronously
+#: on the compiling thread, so the innermost ``compiling_as`` label at event
+#: time names the core being compiled
+_tls = threading.local()
+
 
 class GuardViolation(RuntimeError):
     """A runtime guard's asserted bound was exceeded."""
+
+
+def _label_stack() -> List[str]:
+    stack = getattr(_tls, "labels", None)
+    if stack is None:
+        stack = _tls.labels = []
+    return stack
+
+
+def _current_label() -> Optional[str]:
+    stack = getattr(_tls, "labels", None)
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def compiling_as(label: str):
+    """Attribute any XLA compile fired inside the scope to ``label``.
+
+    The solver dispatch sites wrap their core calls in this, so a
+    :class:`CompilationGuard` report names the offending core
+    (``by_name``) instead of just a phase total — a cold-boot gate failure
+    says *which* executable missed the AOT cache.
+    """
+    stack = _label_stack()
+    stack.append(str(label))
+    try:
+        yield
+    finally:
+        stack.pop()
 
 
 def _install_listener() -> None:
@@ -60,9 +94,11 @@ def _install_listener() -> None:
         def _on_duration(event: str, duration: float, **kw) -> None:
             if not event.endswith(_COMPILE_EVENT_SUFFIX):
                 return
+            label = _current_label() or "unattributed"
             with _lock:
                 for guard in _active_guards:
                     guard.count += 1
+                    guard.by_name[label] = guard.by_name.get(label, 0) + 1
 
         jax.monitoring.register_event_duration_secs_listener(_on_duration)
         _listener_installed = True
@@ -93,10 +129,14 @@ class CompilationGuard:
         self.log = log
         self.max_compiles = max_compiles
         self.count = 0
+        #: compiles attributed per core label (``compiling_as`` scopes);
+        #: compiles outside any label land under "unattributed"
+        self.by_name: dict = {}
 
     def __enter__(self) -> "CompilationGuard":
         _install_listener()
         self.count = 0
+        self.by_name = {}
         with _lock:
             _active_guards.append(self)
         return self
@@ -114,10 +154,15 @@ class CompilationGuard:
             and self.max_compiles is not None
             and self.count > self.max_compiles
         ):
+            blame = ", ".join(
+                f"{k}={v}"
+                for k, v in sorted(self.by_name.items(), key=lambda kv: -kv[1])
+            )
             raise GuardViolation(
                 f"{self.name}: {self.count} XLA compilations inside a scope "
                 f"bounded at {self.max_compiles} — a shape left its padding "
                 f"bucket or a jit is being rebuilt per call"
+                + (f" (by core: {blame})" if blame else "")
             )
 
 

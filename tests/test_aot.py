@@ -1,0 +1,289 @@
+"""graftboot AOT executable-cache tests (``citizensassemblies_tpu/aot/``).
+
+The serving contract under test, rung by rung:
+
+* round-trip: a recorded, serialized, re-loaded executable serves the SAME
+  call bit-identically, counted as a hit, and pre-warming touches it;
+* every failure rung falls back to the plain jit — counted, never a crash:
+  signature miss (``aot_cache_miss``), corrupt artifact (empty store,
+  status ``corrupt``), fingerprint mismatch (every entry stale at load),
+  per-entry payload rot (lazy deserialization books the stale at first
+  lookup) — and each fallback's result stays bit-identical;
+* tri-state ``Config.aot_cache``: ``True`` fails LOUD on a missing or
+  unreadable artifact (fleets that must not boot cold), ``None`` boots
+  quietly without one, ``False`` never loads;
+* the service boots the store and stamps its counters on request audits;
+* ``CompilationGuard`` attributes compile events to the active
+  ``compiling_as`` core label (unlabeled compiles book as "unattributed").
+"""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.aot import boot
+from citizensassemblies_tpu.aot.store import (
+    ExecStore,
+    Recorder,
+    aot_seeded,
+    call_signature,
+    install_recorder,
+    install_store,
+    load_store,
+    platform_fingerprint,
+    save_artifact,
+)
+from citizensassemblies_tpu.utils.config import default_config
+from citizensassemblies_tpu.utils.guards import CompilationGuard, compiling_as
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """The store/recorder are process globals — never leak across tests."""
+    install_store(None)
+    install_recorder(None)
+    yield
+    install_store(None)
+    install_recorder(None)
+
+
+@jax.jit
+def _tiny_core(x):
+    return x * 2.0 + 1.0
+
+
+def _build_tiny(tmp_path, family="test.tiny"):
+    """A one-entry artifact built exactly the way build.py builds: record a
+    live SeededJit call, lower at the recorded avals, serialize, save."""
+    from jax.experimental.serialize_executable import serialize
+
+    fn = aot_seeded(family, _tiny_core)
+    rec = Recorder()
+    install_recorder(rec)
+    x = jnp.arange(8, dtype=jnp.float32)
+    expected = np.asarray(fn(x))
+    install_recorder(None)
+
+    entries = []
+    for (fam, sig), spec in rec.entries.items():
+        lowered = spec["fn"].lower(*spec["lower_args"], **spec["lower_kwargs"])
+        payload, in_tree, out_tree = serialize(lowered.compile())
+        entries.append(
+            {
+                "key": f"{fam}|{sig}",
+                "family": fam,
+                "sig": sig,
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+                "args": spec["args"],
+                "dyn_kwargs": spec["dyn_kwargs"],
+                "static_kwargs": {},
+                "donation": 0,
+            }
+        )
+    path = str(tmp_path / "aot_cache.pkl")
+    sha = save_artifact(path, entries, workload={"test": True})
+    return fn, x, expected, path, sha
+
+
+# --- round trip ---------------------------------------------------------------
+
+
+def test_roundtrip_hit_is_bit_identical(tmp_path):
+    fn, x, expected, path, sha = _build_tiny(tmp_path)
+    store = load_store(path)
+    assert store is not None and store.status == "ok" and store.sha == sha
+    assert len(store) == 1
+    install_store(store)
+    got = np.asarray(fn(x))
+    assert store.hits == 1 and store.misses == 0 and store.stale == 0
+    assert np.array_equal(got, expected)
+
+
+def test_store_off_is_pass_through(tmp_path):
+    fn, x, expected, path, _sha = _build_tiny(tmp_path)
+    # no store installed: the wrapper is the plain jit path by construction
+    assert np.array_equal(np.asarray(fn(x)), expected)
+    store = load_store(path)
+    install_store(store)
+    hit = np.asarray(fn(x))
+    install_store(None)
+    assert np.array_equal(hit, expected)
+
+
+def test_prewarm_touches_entries(tmp_path):
+    _fn, _x, _expected, path, _sha = _build_tiny(tmp_path)
+    store = load_store(path)
+    assert store.prewarm() == 1
+    assert store.prewarmed == 1
+    assert store.prewarm(families=("other.",)) == 0
+
+
+# --- fallback ladder ----------------------------------------------------------
+
+
+def test_signature_miss_counts_and_falls_back(tmp_path):
+    fn, _x, _expected, path, _sha = _build_tiny(tmp_path)
+    store = load_store(path)
+    install_store(store)
+    y = jnp.arange(16, dtype=jnp.float32)  # a shape the cache never saw
+    got = np.asarray(fn(y))
+    assert store.misses == 1 and store.hits == 0
+    assert np.array_equal(got, np.asarray(y) * 2.0 + 1.0)
+
+
+def test_corrupt_artifact_is_empty_store(tmp_path):
+    fn, x, expected, path, _sha = _build_tiny(tmp_path)
+    with open(path, "wb") as fh:
+        fh.write(b"not a pickle")
+    store = load_store(path)
+    assert store.status == "corrupt" and len(store) == 0
+    install_store(store)
+    assert np.array_equal(np.asarray(fn(x)), expected)  # jit fallback
+    assert store.misses == 1
+    with pytest.raises(RuntimeError, match="unreadable"):
+        load_store(path, require=True)
+
+
+def test_fingerprint_mismatch_marks_all_stale(tmp_path):
+    fn, x, expected, path, _sha = _build_tiny(tmp_path)
+    with open(path, "rb") as fh:
+        doc = pickle.load(fh)
+    doc["fingerprint"] = dict(doc["fingerprint"], jax="0.0.0")
+    with open(path, "wb") as fh:
+        pickle.dump(doc, fh)
+    store = load_store(path)
+    assert store.status == "fingerprint_mismatch"
+    assert store.stale == 1 and len(store) == 0
+    install_store(store)
+    assert np.array_equal(np.asarray(fn(x)), expected)  # jit fallback
+    with pytest.raises(RuntimeError, match="built for"):
+        load_store(path, require=True)
+
+
+def test_rotten_payload_goes_stale_at_first_lookup(tmp_path):
+    fn, x, expected, path, _sha = _build_tiny(tmp_path)
+    with open(path, "rb") as fh:
+        doc = pickle.load(fh)
+    doc["entries"][0]["payload"] = b"\x00rot"
+    with open(path, "wb") as fh:
+        pickle.dump(doc, fh)
+    store = load_store(path)
+    assert store.status == "ok" and len(store) == 1  # rot is found lazily
+    install_store(store)
+    got = np.asarray(fn(x))
+    assert np.array_equal(got, expected)  # jit fallback, bit-identical
+    assert store.stale == 1 and store.hits == 0 and store.misses == 1
+
+
+# --- tri-state boot -----------------------------------------------------------
+
+
+def test_boot_tri_state(tmp_path):
+    missing = str(tmp_path / "nope.pkl")
+    cfg = default_config().replace(aot_cache=None, aot_cache_path=missing)
+    assert boot(cfg) is None  # auto: missing cache boots quietly
+    cfg_off = cfg.replace(aot_cache=False)
+    assert boot(cfg_off) is None  # hard off: never loads
+    cfg_req = cfg.replace(aot_cache=True)
+    with pytest.raises(RuntimeError, match="make aot-cache"):
+        boot(cfg_req)  # required: fails loud, names the remedy
+
+
+def test_boot_installs_store(tmp_path):
+    _fn, _x, _expected, path, sha = _build_tiny(tmp_path)
+    from citizensassemblies_tpu.aot.store import active_store
+
+    cfg = default_config().replace(aot_cache=True, aot_cache_path=path)
+    store = boot(cfg)
+    assert store is not None and store.sha == sha
+    assert active_store() is store
+
+
+# --- service integration ------------------------------------------------------
+
+
+def test_service_boots_store_and_stamps_audit(tmp_path):
+    from citizensassemblies_tpu.core.generator import random_instance
+    from citizensassemblies_tpu.service import SelectionRequest, SelectionService
+
+    _fn, _x, _expected, path, sha = _build_tiny(tmp_path)
+    cfg = default_config().replace(aot_cache=True, aot_cache_path=path)
+    svc = SelectionService(cfg)
+    try:
+        assert svc.aot_store is not None and svc.aot_store.sha == sha
+        res = svc.run(
+            SelectionRequest(
+                instance=random_instance(n=12, k=3, n_categories=2, seed=0)
+            ),
+            timeout=600,
+        )
+        assert res.audit["aot"]["cache_sha"] == sha
+        assert res.audit["aot"]["status"] == "ok"
+        text = svc.metrics_text()
+        assert "aot_cache_hit" in text and "aot_cache_stale" in text
+    finally:
+        svc.shutdown() if hasattr(svc, "shutdown") else None
+
+
+def test_service_requires_cache_fails_at_construction(tmp_path):
+    from citizensassemblies_tpu.service import SelectionService
+
+    cfg = default_config().replace(
+        aot_cache=True, aot_cache_path=str(tmp_path / "absent.pkl")
+    )
+    with pytest.raises(RuntimeError, match="make aot-cache"):
+        SelectionService(cfg)
+
+
+# --- signatures ---------------------------------------------------------------
+
+
+def test_call_signature_statics_by_value_scalars_by_type():
+    x = jnp.zeros((4, 8), jnp.float32)
+    a = call_signature((x,), {"k": 3}, static_argnames=("k",))
+    b = call_signature((x,), {"k": 4}, static_argnames=("k",))
+    assert a != b  # statics are part of the compiled program
+    c = call_signature((x, 3), {})
+    d = call_signature((x, 4), {})
+    assert c == d  # dynamic python ints share one executable
+
+
+def test_platform_fingerprint_identity():
+    assert platform_fingerprint() == platform_fingerprint()
+
+
+# --- guard attribution --------------------------------------------------------
+
+
+def test_guard_attributes_compiles_per_core():
+    @jax.jit
+    def _fresh(x):
+        return jnp.tanh(x) * 3.0
+
+    with CompilationGuard(name="attr") as g:
+        with compiling_as("test.core_a"):
+            _fresh(jnp.arange(7, dtype=jnp.float32))
+    assert g.count >= 1
+    assert g.by_name.get("test.core_a") == g.count
+
+    @jax.jit
+    def _fresh2(x):
+        return jnp.tanh(x) + 5.0
+
+    with CompilationGuard(name="attr2") as g2:
+        _fresh2(jnp.arange(9, dtype=jnp.float32))
+    assert g2.by_name.get("unattributed") == g2.count
+
+
+def test_stamp_schema():
+    store = ExecStore(sha="abc", status="ok")
+    st = store.stamp()
+    assert set(st) == {
+        "hits", "misses", "stale", "prewarmed", "entries", "cache_sha",
+        "status",
+    }

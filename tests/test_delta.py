@@ -90,6 +90,25 @@ def test_churn_trail_covers_edit_classes():
     assert {"agents_add", "agents_drop", "quota_relax", "quota_tighten"} <= kinds
 
 
+@pytest.mark.parametrize("seed", [0, 3, 11])
+def test_churn_relax_never_widens_both_arms(seed):
+    """A ``quota_relax`` edit moves exactly ONE band edge: the single-unit
+    edit grammar every consumer (delta sensitivity, trail replays) is sized
+    for. The regression this pins: the generator relaxing lo AND hi in one
+    emitted edit whenever both arms happened to be open."""
+    reg = _registry()
+    trail = churn_trail(
+        reg, 60, seed=seed, max_edit_agents=16,
+        weights={"quota_relax": 0.7, "quota_tighten": 0.3},
+    )
+    relaxes = [e for e in trail if e.kind == "quota_relax"]
+    assert relaxes, "weighted trail emitted no quota_relax edits"
+    for e in relaxes:
+        assert (e.dlo, e.dhi) in ((-1, 0), (0, 1)), (
+            f"quota_relax widened both arms: dlo={e.dlo} dhi={e.dhi}"
+        )
+
+
 def test_drop_witness_member_rejected():
     reg = _registry()
     edit = RegistryEdit(
