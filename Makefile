@@ -31,15 +31,29 @@ update-ir-budget:
 # cores under 1/2/4/8 virtual devices — and verify the collective census
 # against SPMD_BUDGET.json, the declared dist/partition.py sharding
 # contracts, and precision-flow cert isolation. The census diff lands in
-# SPMD_BUDGET_DIFF.json and the S3 artifact in PRECISION_FLOW.json (both
-# uploaded as CI artifacts).
+# SPMD_BUDGET_DIFF.json and the S3 artifact in artifacts/PRECISION_FLOW.json
+# (both uploaded as CI artifacts).
 check-spmd:
-	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m citizensassemblies_tpu.lint --spmd --diff-out SPMD_BUDGET_DIFF.json --precision-out PRECISION_FLOW.json
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m citizensassemblies_tpu.lint --spmd --diff-out SPMD_BUDGET_DIFF.json --precision-out artifacts/PRECISION_FLOW.json
+
+# graftgrade (lint/prec.py): walk every registered core's jaxpr with the
+# error-flow abstract interpreter, ratchet the verdict against the committed
+# PRECISION_PLAN.json, and census the compiled HLO of every committed bf16
+# demotion (no silent re-upcast, no bf16 into a cert sink). The
+# measured-vs-plan diff lands in PRECISION_PLAN_DIFF.json (uploaded as a CI
+# artifact).
+check-prec:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m citizensassemblies_tpu.lint --prec --diff-out PRECISION_PLAN_DIFF.json
+
+# deliberate ratchet move: re-certify every core and rewrite
+# PRECISION_PLAN.json (P1/P3 still fail)
+update-prec-plan:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m citizensassemblies_tpu.lint --prec --update-prec-plan
 
 # deliberate ratchet move: re-measure every core's collective census and
 # rewrite SPMD_BUDGET.json (S2/S3 still fail)
 update-spmd-budget:
-	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m citizensassemblies_tpu.lint --spmd --update-spmd-budget --precision-out PRECISION_FLOW.json
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m citizensassemblies_tpu.lint --spmd --update-spmd-budget --precision-out artifacts/PRECISION_FLOW.json
 
 # grafttrace bench trend gate (obs/trend.py): per-row regression check over
 # the committed BENCH_*.json / BENCH_serve_*.json trajectory. Stdlib-only —

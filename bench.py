@@ -758,6 +758,14 @@ def main() -> None:
         result["spmd_budget"] = spmd_budget_provenance()
     except Exception:
         result["spmd_budget"] = {"error": "unavailable"}
+    # and the PRECISION_PLAN.json certification state (graftgrade) — which
+    # committed bf16 demotion plan this row's numbers ran under
+    try:
+        from citizensassemblies_tpu.lint.prec import prec_plan_provenance
+
+        result["prec_plan"] = prec_plan_provenance()
+    except Exception:
+        result["prec_plan"] = {"error": "unavailable"}
     try:
         from citizensassemblies_tpu.utils.memo import memo_evictions
 
@@ -802,6 +810,8 @@ def main() -> None:
         summary["ir_budget"] = result["ir_budget"]["sha256"]
     if isinstance(result.get("spmd_budget"), dict) and "sha256" in result["spmd_budget"]:
         summary["spmd_budget"] = result["spmd_budget"]["sha256"]
+    if isinstance(result.get("prec_plan"), dict) and "sha256" in result["prec_plan"]:
+        summary["prec_plan"] = result["prec_plan"]["sha256"]
     flag = {}
     for key in (
         "sf_e_skewed", "sf_e_skewed_seed0", "sf_e_skewed_seed2",

@@ -57,6 +57,7 @@ from citizensassemblies_tpu.aot.store import aot_seeded
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.precision import iterate_dtype
 from citizensassemblies_tpu.utils.guards import no_implicit_transfers
 
 __all__ = [
@@ -432,7 +433,7 @@ def _mk_two_sided_body(
 
     T = v.shape[0]
     B, C = colmask.shape
-    f32 = val.dtype
+    f32 = iterate_dtype(val.dtype)
     absV = jnp.abs(val)
 
     def prelude(cm, x0_l, lam0_l, mu0_l):
@@ -850,7 +851,7 @@ def _mk_lp_body(
     m1 = idx.shape[0]
     nv = c.shape[0]
     m2 = A.shape[0]
-    f32 = val.dtype
+    f32 = iterate_dtype(val.dtype)
     absV = jnp.abs(val)
     absA = jnp.abs(A)
 
@@ -1041,6 +1042,17 @@ def _ir_megakernel_two_sided() -> IRCase:
             max_iters=1024, check_every=128, sentinel=False, interpret=True
         ),
         donate_expected=2,
+        arg_ranges=(
+            None,
+            (0.0, 256.0, True),
+            (0.0, 1.0, False),
+            (0.0, 1.0, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(1,),  # packed ELL values
     )
 
 
@@ -1072,4 +1084,17 @@ def _ir_megakernel_lp() -> IRCase:
             max_iters=1024, check_every=128, sentinel=False, interpret=True
         ),
         donate_expected=3,
+        arg_ranges=(
+            (-1e4, 1e4, False),
+            None,
+            (0.0, 256.0, True),
+            (-1e4, 1e4, False),
+            (0.0, 256.0, True),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(2,),  # packed ELL values
     )

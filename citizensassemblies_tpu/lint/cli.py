@@ -10,8 +10,13 @@ every registered core is AOT-compiled — the mesh-consuming ones under
 1/2/4/8-device virtual meshes — and checked for collective-census
 regressions against ``SPMD_BUDGET.json`` (``--update-spmd-budget``
 re-ratchets), sharding-contract violations, and precision-flow isolation
-(``--precision-out`` writes ``PRECISION_FLOW.json``). ``--format json``
-emits the stable machine schema for any pass — the three passes share the
+(``--precision-out`` writes ``artifacts/PRECISION_FLOW.json``). ``--prec``
+runs the fourth pass (``lint.prec``, graftgrade): every registered core's
+jaxpr is walked by the error-flow abstract interpreter, the verdict is
+ratcheted against ``PRECISION_PLAN.json`` (``--update-prec-plan``
+re-certifies), and each committed demotion is cross-checked against the
+compiled HLO's dtype census. ``--format json`` emits the stable machine
+schema for any pass — the four passes share the
 ``{"schema_version", "pass", "ok", ..., "violations": [...]}`` envelope.
 """
 
@@ -101,8 +106,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     parser.add_argument(
         "--diff-out", type=Path, default=None,
-        help="with --ir/--spmd: write the measured-vs-budget diff JSON here "
-        "(the CI build artifact)",
+        help="with --ir/--spmd/--prec: write the measured-vs-budget diff "
+        "JSON here (the CI build artifact)",
     )
     parser.add_argument(
         "--spmd", action="store_true",
@@ -124,7 +129,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument(
         "--precision-out", type=Path, default=None,
         help="with --spmd: write the S3 precision-flow artifact here "
-        "(PRECISION_FLOW.json in CI)",
+        "(artifacts/PRECISION_FLOW.json in CI)",
+    )
+    parser.add_argument(
+        "--prec", action="store_true",
+        help="run the graftgrade precision certifier (error-flow abstract "
+        "interpretation, PRECISION_PLAN.json ratchet, compiled-HLO dtype "
+        "census of every committed bf16 demotion) over the registered cores",
+    )
+    parser.add_argument(
+        "--prec-plan", type=Path, default=None,
+        help="precision-plan file for --prec (default: PRECISION_PLAN.json "
+        "at the repo root)",
+    )
+    parser.add_argument(
+        "--update-prec-plan", action="store_true",
+        help="with --prec: re-certify every core and REWRITE the plan file "
+        "(the deliberate ratchet move); P1/P3 still fail",
     )
     args = parser.parse_args(argv)
 
@@ -132,9 +153,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         parser.error("--update-budget requires --ir")
     if args.update_spmd_budget and not args.spmd:
         parser.error("--update-spmd-budget requires --spmd")
-    if args.ir and args.spmd:
-        parser.error("--ir and --spmd are separate passes; run them "
+    if args.update_prec_plan and not args.prec:
+        parser.error("--update-prec-plan requires --prec")
+    if sum(1 for f in (args.ir, args.spmd, args.prec) if f) > 1:
+        parser.error("--ir, --spmd and --prec are separate passes; run them "
                      "separately")
+    if args.prec:
+        if args.paths:
+            parser.error("--prec certifies the registered cores; paths are "
+                         "for the AST pass")
+        _bootstrap_virtual_devices()
+        from citizensassemblies_tpu.lint.prec import (
+            prec_plan_diff,
+            prec_report_as_json,
+            render_prec_report,
+            run_prec_checks,
+        )
+
+        report = run_prec_checks(
+            plan_path=args.prec_plan, update_plan=args.update_prec_plan
+        )
+        if args.diff_out is not None:
+            args.diff_out.write_text(
+                json.dumps(prec_plan_diff(report), indent=1, sort_keys=True)
+                + "\n",
+                encoding="utf-8",
+            )
+        if args.format == "json":
+            print(json.dumps(prec_report_as_json(report), indent=1))
+        else:
+            rendered = render_prec_report(report)
+            if args.quiet:
+                rendered = "\n".join(v.render() for v in report.violations)
+            if rendered:
+                print(rendered)
+        return 0 if report.ok else 1
     if args.spmd:
         if args.paths:
             parser.error("--spmd verifies the registered cores; paths are "

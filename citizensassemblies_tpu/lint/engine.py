@@ -174,12 +174,13 @@ def iter_python_files(paths: Iterable[Path]) -> List[Path]:
 
 
 def all_rules():
-    """The registered rule set, R1..R12 (R0 is emitted by the engine itself)."""
+    """The registered rule set, R1..R13 (R0 is emitted by the engine itself)."""
     from citizensassemblies_tpu.lint.config_rule import ConfigKnobRule
     from citizensassemblies_tpu.lint.rules import (
         CoreSpanRule,
         DonatedBufferReuseRule,
         DtypeDisciplineRule,
+        DtypeLiteralHygieneRule,
         FaultSiteRule,
         HostSyncInJitRule,
         JitConstructionRule,
@@ -203,6 +204,7 @@ def all_rules():
         MeshHygieneRule(),
         MetricHygieneRule(),
         ShardingSpecHygieneRule(),
+        DtypeLiteralHygieneRule(),
     ]
 
 

@@ -1,0 +1,1143 @@
+"""graftgrade: jaxpr-level precision-flow certification (the fourth pass).
+
+graftspmd's S3 classifies precision per *scope*; this pass is the
+per-primitive refinement that lets the repo actually SPEND the roofline
+headroom: a static certificate of where bf16 operand demotion is safe, a
+ratcheted plan artifact recording the verdict, and a compiled-truth census
+proving the applied plan survived XLA. Three check families:
+
+* **P1 error-flow abstract interpretation** — for every ``@register_ir_core``
+  entry the jaxpr (sub-jaxprs included) is walked propagating, per variable,
+  a dynamic-range interval and a relative-error amplification bound over the
+  primitive set the repo actually uses (dot/ELL gather-scatter, prox/clip,
+  segment reductions, while-carry fixpoints via the sentinel contract: carry
+  seeds are TOP, so nothing derived from a fixpoint iterate ever certifies).
+  Every intermediate is classified ``bf16_safe`` / ``f32_required`` /
+  ``f64_cert`` — accumulation outputs and comparison operands are pinned
+  ``>=f32`` by rule, and an input argument certifies for demotion only when
+  its registration declares it exactly representable at bf16
+  (``IRCase.arg_ranges``) AND the walk proves the demoted storage adds zero
+  relative error. The certifier proves LOSSLESS demotion; the runtime
+  (``utils/precision.demote_operator``) enforces the same property per
+  concrete array, so engaged-vs-off stays bit-identical.
+* **P2 ratcheted plan artifact** — the classification is committed as
+  ``PRECISION_PLAN.json`` (root, next to ANALYSIS_BUDGET / SPMD_BUDGET) and
+  ratcheted with the same discipline: missing / stale (jaxpr fingerprint) /
+  downgraded (plan claims more bf16 than the analysis certifies) / doctored
+  (class counts no longer cover the traced variables) entries are named
+  FAILs; ``--update-prec-plan`` regenerates deliberately; the plan sha256 is
+  stamped on bench rows (:func:`prec_plan_provenance`).
+* **P3 compiled-truth cross-check** — each demoted core is re-lowered with
+  its certified arguments at bf16 and the compiled HLO is censused: the
+  demoted parameter must still be bf16 in the entry signature (no silent
+  XLA re-upcast on the demoted edge), a cert core (``allow_f64``) must show
+  ZERO bf16 anywhere (no bf16 into an S3 ``f64_cert`` sink — cross-checked
+  against ``precision_flow``'s ``cert_isolated`` on the demoted trace), and
+  the static operand-bytes traffic model records the HBM reduction per core
+  (CPU/interpret regime: the README records the hardware waiver — XLA:CPU
+  legalizes bf16 through f32 converts, so the bytes win is measured at the
+  operand interface, not the CPU cost model).
+
+Run as ``python -m citizensassemblies_tpu.lint --prec`` (or ``make
+check-prec``); reports use graftlint's ``file:line`` contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from citizensassemblies_tpu.lint.engine import Violation
+from citizensassemblies_tpu.lint.ir import _trace_jaxpr
+from citizensassemblies_tpu.lint.registry import CoreEntry, IRCase, collect
+from citizensassemblies_tpu.utils.precision import PLAN_PATH
+
+#: unit roundoffs of the three storage formats the certifier reasons about
+BF16_EPS = 2.0 ** -8
+F32_EPS = 2.0 ** -24
+F64_EPS = 2.0 ** -53
+
+#: bf16 shares f32's exponent range; overflow is ~3.39e38 and integers are
+#: exactly representable up to 2**8 (8-bit significand)
+BF16_MAX = 3.38e38
+BF16_EXACT_INT = 256.0
+
+#: relative-error bounds are capped here in reports (inf ⇒ "unbounded",
+#: serialized as null)
+_REL_CAP = 1e30
+
+#: accumulation primitives: their OUTPUTS are pinned >=f32 by rule — a bf16
+#: accumulator loses the 1e-6 KKT resolution no matter how exact the terms
+ACCUM_PRIMS = frozenset(
+    {
+        "dot_general", "reduce_sum", "cumsum", "add_any",
+        "segment_sum", "scatter-add", "scatter_add",
+    }
+)
+
+#: consumers that pin their float operands >=f32 (the S3 set): comparisons
+#: decide convergence/KKT acceptance, ordering ties flip under narrowing,
+#: callbacks/custom calls are opaque
+from citizensassemblies_tpu.lint.spmd import _PIN_PRIMS, precision_flow  # noqa: E402
+
+
+# --- P1: the abstract domain -------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AbsVal:
+    """One variable's abstract state: dynamic-range interval ``[lo, hi]``,
+    relative-error amplification bound ``rel`` (an upper bound on
+    |computed − exact| / |exact| accumulated from storage roundoff and
+    primitive rounding; ``inf`` = unbounded, e.g. past a cancellation), and
+    ``exact`` — the value is exactly representable at bf16 (integer-valued,
+    magnitude ≤ 256) with zero accumulated error."""
+
+    lo: float
+    hi: float
+    rel: float
+    exact: bool = False
+
+    @property
+    def mag(self) -> float:
+        return max(abs(self.lo), abs(self.hi))
+
+    def nonneg(self) -> bool:
+        return self.lo >= 0.0
+
+    def nonpos(self) -> bool:
+        return self.hi <= 0.0
+
+
+#: the lattice top: unknown range, unbounded error (while-carry seeds, any
+#: primitive without a transfer function)
+TOP = AbsVal(-math.inf, math.inf, math.inf, False)
+
+
+def _join(a: AbsVal, b: AbsVal) -> AbsVal:
+    return AbsVal(
+        min(a.lo, b.lo), max(a.hi, b.hi), max(a.rel, b.rel),
+        a.exact and b.exact,
+    )
+
+
+def _mul_bound(x: float, y: float) -> float:
+    """Interval-endpoint product with 0·inf = 0 (an exactly-zero endpoint
+    annihilates even an unbounded one)."""
+    if x == 0.0 or y == 0.0:
+        return 0.0
+    return x * y
+
+
+def _interval_mul(a: AbsVal, b: AbsVal) -> Tuple[float, float]:
+    cands = [
+        _mul_bound(a.lo, b.lo), _mul_bound(a.lo, b.hi),
+        _mul_bound(a.hi, b.lo), _mul_bound(a.hi, b.hi),
+    ]
+    return min(cands), max(cands)
+
+
+def _compose_rel(*rels: float, eps: float = F32_EPS, steps: int = 1) -> float:
+    """Sound first-order-free composition: Π(1+rᵢ)·(1+eps)^steps − 1."""
+    acc = 1.0
+    for r in rels:
+        if math.isinf(r):
+            return math.inf
+        acc *= 1.0 + r
+    for _ in range(min(steps, 64)):
+        acc *= 1.0 + eps
+    if steps > 64:
+        acc *= math.exp(steps * eps)  # ≥ (1+eps)^steps for eps ≥ 0
+    return acc - 1.0
+
+
+def _same_sign(a: AbsVal, b: AbsVal) -> bool:
+    return (a.nonneg() and b.nonneg()) or (a.nonpos() and b.nonpos())
+
+
+def _add(a: AbsVal, b: AbsVal, sub: bool = False) -> AbsVal:
+    if sub:
+        b = AbsVal(-b.hi, -b.lo, b.rel, b.exact)
+    lo, hi = a.lo + b.lo, a.hi + b.hi
+    if _same_sign(a, b):
+        # no cancellation: the result is a convex-ish mix of the operand
+        # errors, plus one rounding
+        rel = _compose_rel(max(a.rel, b.rel))
+    else:
+        # possible cancellation: relative error is unbounded at the zero
+        # crossing — sound, and exactly why iterate arithmetic pins f32
+        rel = math.inf
+    exact = (
+        a.exact and b.exact
+        and max(abs(lo), abs(hi)) <= BF16_EXACT_INT
+    )
+    return AbsVal(lo, hi, 0.0 if exact else rel, exact)
+
+
+def _mul(a: AbsVal, b: AbsVal) -> AbsVal:
+    lo, hi = _interval_mul(a, b)
+    rel = _compose_rel(a.rel, b.rel)
+    exact = a.exact and b.exact and max(abs(lo), abs(hi)) <= BF16_EXACT_INT
+    return AbsVal(lo, hi, 0.0 if exact else rel, exact)
+
+
+def _div(a: AbsVal, b: AbsVal) -> AbsVal:
+    if b.lo <= 0.0 <= b.hi:
+        return AbsVal(-math.inf, math.inf, math.inf, False)
+    inv = AbsVal(1.0 / b.hi, 1.0 / b.lo, b.rel, False)
+    lo, hi = _interval_mul(a, inv)
+    return AbsVal(lo, hi, _compose_rel(a.rel, b.rel), False)
+
+
+def _reduce_sum_like(a: AbsVal, n: int) -> AbsVal:
+    n = max(int(n), 1)
+    lo = min(n * a.lo, a.lo)
+    hi = max(n * a.hi, a.hi)
+    if a.nonneg() or a.nonpos():
+        rel = _compose_rel(a.rel, steps=n)
+    else:
+        rel = math.inf
+    return AbsVal(lo, hi, rel, False)
+
+
+def _passthrough(ins: List[AbsVal]) -> AbsVal:
+    out = ins[0]
+    for v in ins[1:]:
+        out = _join(out, v)
+    return out
+
+
+def _reduction_count(eqn) -> int:
+    """Number of terms each output element of a reduction accumulates."""
+    in_sz = max(
+        (int(math.prod(getattr(v.aval, "shape", ()) or (1,))) for v in eqn.invars if hasattr(v, "aval")),
+        default=1,
+    )
+    out_sz = max(
+        (int(math.prod(getattr(v.aval, "shape", ()) or (1,))) for v in eqn.outvars if hasattr(v, "aval")),
+        default=1,
+    )
+    return max(in_sz // max(out_sz, 1), 1)
+
+
+def _transfer(eqn, ins: List[AbsVal]) -> AbsVal:
+    """The per-primitive transfer function; conservative TOP default."""
+    name = eqn.primitive.name
+    if name in ("add",):
+        return _add(ins[0], ins[1])
+    if name in ("sub",):
+        return _add(ins[0], ins[1], sub=True)
+    if name == "mul":
+        return _mul(ins[0], ins[1])
+    if name == "div":
+        return _div(ins[0], ins[1])
+    if name == "neg":
+        a = ins[0]
+        return AbsVal(-a.hi, -a.lo, a.rel, a.exact)
+    if name == "abs":
+        a = ins[0]
+        lo = 0.0 if a.lo <= 0.0 <= a.hi else min(abs(a.lo), abs(a.hi))
+        return AbsVal(lo, a.mag, a.rel, a.exact)
+    if name in ("max", "min"):
+        a, b = ins[0], ins[1]
+        if name == "max":
+            lo, hi = max(a.lo, b.lo), max(a.hi, b.hi)
+        else:
+            lo, hi = min(a.lo, b.lo), min(a.hi, b.hi)
+        # a rounding-perturbed max can switch branch, but the returned value
+        # is one of the operands: error ≤ max of operand errors (+ the gap
+        # at a near-tie, absorbed by the operand bound)
+        return AbsVal(lo, hi, max(a.rel, b.rel), a.exact and b.exact)
+    if name in ("clamp",):  # clamp(lo, x, hi) — prox/projection steps
+        lo_v, x, hi_v = ins[0], ins[1], ins[2]
+        return AbsVal(
+            max(x.lo, lo_v.lo), min(x.hi, hi_v.hi),
+            max(x.rel, lo_v.rel, hi_v.rel), False,
+        )
+    if name == "sqrt":
+        a = ins[0]
+        if a.lo < 0.0:
+            return TOP
+        return AbsVal(
+            math.sqrt(a.lo), math.sqrt(a.hi),
+            _compose_rel(0.5 * a.rel if not math.isinf(a.rel) else a.rel),
+            False,
+        )
+    if name == "exp":
+        a = ins[0]
+        if math.isinf(a.mag) or math.isinf(a.rel):
+            return TOP
+        # d(e^x)/e^x = dx: relative error scales with |x| · rel_abs; bound
+        # via the absolute perturbation |x|·rel
+        pert = a.mag * a.rel
+        if pert > 700.0:
+            return TOP
+        return AbsVal(
+            math.exp(a.lo), math.exp(a.hi),
+            _compose_rel(math.expm1(pert) if pert < 700 else math.inf),
+            False,
+        )
+    if name in ("reduce_sum", "cumsum", "add_any"):
+        return _reduce_sum_like(_passthrough(ins), _reduction_count(eqn))
+    if name == "dot_general":
+        prod = _mul(ins[0], ins[1])
+        dims = eqn.params.get("dimension_numbers")
+        n = 1
+        try:
+            (lhs_c, _), _ = dims
+            shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+            for d in lhs_c:
+                n *= int(shape[d])
+        except Exception:  # noqa: BLE001 - fall back to the coarse count
+            n = _reduction_count(eqn)
+        return _reduce_sum_like(prod, n)
+    if name in ("reduce_max", "reduce_min", "argmax", "argmin"):
+        a = _passthrough(ins)
+        return AbsVal(a.lo, a.hi, a.rel, False)
+    if name in ("gather", "take", "dynamic_slice", "slice", "squeeze",
+                "reshape", "broadcast_in_dim", "transpose", "rev",
+                "expand_dims", "copy", "stop_gradient", "dynamic_update_slice",
+                "concatenate", "pad", "select_n", "where"):
+        # structural / selection: values are drawn from the operands
+        return _passthrough([v for v in ins if v is not None] or [TOP])
+    if name in ("segment_sum", "scatter-add", "scatter_add"):
+        return _reduce_sum_like(_passthrough(ins), _reduction_count(eqn))
+    if name == "convert_element_type":
+        a = ins[0]
+        new = str(eqn.params.get("new_dtype", ""))
+        if new.startswith("bfloat16"):
+            if a.exact:
+                return a  # lossless by construction
+            return AbsVal(a.lo, a.hi, _compose_rel(a.rel, eps=BF16_EPS), False)
+        if new.startswith("float"):
+            return AbsVal(a.lo, a.hi, _compose_rel(a.rel), a.exact)
+        return AbsVal(a.lo, a.hi, a.rel, a.exact)
+    if name in ("integer_pow",):
+        p = int(eqn.params.get("y", 2))
+        out = ins[0]
+        for _ in range(max(p - 1, 0)):
+            out = _mul(out, ins[0])
+        return out
+    if name in ("sign", "floor", "ceil", "round", "iota", "eq", "ne", "lt",
+                "le", "gt", "ge", "and", "or", "not", "xor", "is_finite"):
+        # boolean / integral outputs: exact by construction
+        return AbsVal(-math.inf, math.inf, 0.0, False)
+    return TOP
+
+
+# --- P1: the jaxpr walk ------------------------------------------------------
+
+
+def _const_absval(val) -> AbsVal:
+    import numpy as np
+
+    try:
+        arr = np.asarray(val)
+        if arr.size == 0:
+            return AbsVal(0.0, 0.0, 0.0, True)
+        lo = float(np.min(arr))
+        hi = float(np.max(arr))
+        exact = bool(
+            np.issubdtype(arr.dtype, np.integer)
+            or (
+                np.issubdtype(arr.dtype, np.floating)
+                and max(abs(lo), abs(hi)) <= BF16_EXACT_INT
+                and bool(np.all(arr == np.round(arr)))
+            )
+        )
+        return AbsVal(lo, hi, 0.0, exact)
+    except Exception:  # noqa: BLE001 - opaque const
+        return TOP
+
+
+def _range_absval(rng: Optional[Tuple[float, float, bool]]) -> AbsVal:
+    if rng is None:
+        return AbsVal(-math.inf, math.inf, F32_EPS, False)
+    lo, hi, exact = float(rng[0]), float(rng[1]), bool(rng[2])
+    if exact and max(abs(lo), abs(hi)) <= BF16_EXACT_INT:
+        return AbsVal(lo, hi, 0.0, True)
+    return AbsVal(lo, hi, F32_EPS, False)
+
+
+@dataclasses.dataclass
+class Analysis:
+    """P1 outcome for one core."""
+
+    classes: Dict[str, int]
+    n_vars: int
+    arg_classes: List[str]
+    certified_demote: List[int]
+    out_rel: Optional[float]  # None = unbounded
+    jaxpr_sha: str
+
+
+def _sub_jaxpr_of(item):
+    return getattr(item, "jaxpr", item if hasattr(item, "eqns") else None)
+
+
+class _Interp:
+    """The error-flow abstract interpreter (one instance per core trace)."""
+
+    def __init__(self):
+        self.counts = {
+            "bf16_safe": 0, "f32_required": 0, "f64_cert": 0, "non_float": 0,
+        }
+        self.n_vars = 0
+
+    def _read(self, env, var) -> AbsVal:
+        if hasattr(var, "val"):  # Literal
+            return _const_absval(var.val)
+        return env.get(var, TOP)
+
+    def _classify_scope(self, jaxpr, env) -> None:
+        """Assign a class to every eqn output of THIS scope (sub-jaxprs are
+        classified by their own eval calls)."""
+        outvars = {v for v in jaxpr.outvars if hasattr(v, "aval")}
+        consumers: Dict[Any, List[Any]] = {}
+        for eqn in jaxpr.eqns:
+            for var in eqn.invars:
+                if hasattr(var, "aval") and not hasattr(var, "val"):
+                    consumers.setdefault(var, []).append(eqn)
+        for eqn in jaxpr.eqns:
+            accum = eqn.primitive.name in ACCUM_PRIMS
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                dtype = str(getattr(aval, "dtype", ""))
+                self.n_vars += 1
+                if not dtype.startswith(("float", "bfloat")):
+                    self.counts["non_float"] += 1
+                    continue
+                if dtype == "float64" and not getattr(aval, "weak_type", False):
+                    self.counts["f64_cert"] += 1
+                    continue
+                av = env.get(var, TOP)
+                pinned = accum or var in outvars
+                if not pinned:
+                    for consumer in consumers.get(var, []):
+                        if consumer.primitive.name in _PIN_PRIMS:
+                            pinned = True
+                            break
+                safe = (
+                    not pinned
+                    and av.exact
+                    and av.mag <= BF16_MAX
+                )
+                self.counts["bf16_safe" if safe else "f32_required"] += 1
+
+    def eval_jaxpr(self, jaxpr, in_vals: Sequence[AbsVal], const_vals: Sequence[AbsVal]) -> List[AbsVal]:
+        env: Dict[Any, AbsVal] = {}
+        for var, val in zip(jaxpr.constvars, const_vals):
+            env[var] = val
+        for var, val in zip(jaxpr.invars, in_vals):
+            env[var] = val
+        for eqn in jaxpr.eqns:
+            ins = [self._read(env, v) for v in eqn.invars]
+            outs = self._eval_eqn(eqn, ins)
+            for var, val in zip(eqn.outvars, outs):
+                env[var] = val
+        self._classify_scope(jaxpr, env)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _eval_eqn(self, eqn, ins: List[AbsVal]) -> List[AbsVal]:
+        name = eqn.primitive.name
+        params = eqn.params
+        if name == "pjit" or name in ("closed_call", "core_call", "custom_jvp_call", "custom_vjp_call"):
+            closed = params.get("jaxpr") or params.get("call_jaxpr")
+            sub = _sub_jaxpr_of(closed)
+            if sub is not None:
+                consts = [_const_absval(c) for c in getattr(closed, "consts", [])]
+                return self.eval_jaxpr(sub, ins, consts)
+            return [TOP] * len(eqn.outvars)
+        if name == "while":
+            cn = int(params.get("cond_nconsts", 0))
+            bn = int(params.get("body_nconsts", 0))
+            body = _sub_jaxpr_of(params.get("body_jaxpr"))
+            cond = _sub_jaxpr_of(params.get("cond_jaxpr"))
+            body_consts = ins[cn: cn + bn]
+            n_carry = len(ins) - cn - bn
+            # the sentinel contract: a fixpoint carry is TOP — nothing
+            # derived from the iterate certifies, only loop-invariant
+            # closure operands (the packed operator) keep their state
+            carry = [TOP] * n_carry
+            if body is not None:
+                self.eval_jaxpr(body, list(body_consts) + carry, [])
+            if cond is not None:
+                self.eval_jaxpr(cond, list(ins[:cn]) + carry, [])
+            return [TOP] * len(eqn.outvars)
+        if name == "scan":
+            closed = params.get("jaxpr")
+            sub = _sub_jaxpr_of(closed)
+            nc = int(params.get("num_consts", 0))
+            ncar = int(params.get("num_carry", 0))
+            if sub is not None:
+                consts = list(ins[:nc])
+                carry = [TOP] * ncar
+                xs = list(ins[nc + ncar:])
+                self.eval_jaxpr(sub, consts + carry + xs, [])
+            return [TOP] * len(eqn.outvars)
+        if name == "cond":
+            branches = params.get("branches", ())
+            outs: Optional[List[AbsVal]] = None
+            for br in branches:
+                sub = _sub_jaxpr_of(br)
+                if sub is None:
+                    continue
+                consts = [_const_absval(c) for c in getattr(br, "consts", [])]
+                got = self.eval_jaxpr(sub, ins[1:], consts)
+                outs = got if outs is None else [
+                    _join(a, b) for a, b in zip(outs, got)
+                ]
+            return outs if outs is not None else [TOP] * len(eqn.outvars)
+        # generic sub-jaxpr fallback (pallas kernels, remat, ...) — walk for
+        # classification coverage, return TOP
+        walked = False
+        for value in params.values():
+            items = value if isinstance(value, (list, tuple)) else [value]
+            for item in items:
+                sub = _sub_jaxpr_of(item)
+                if sub is not None:
+                    walked = True
+                    self.eval_jaxpr(sub, [TOP] * len(sub.invars), [
+                        _const_absval(c) for c in getattr(item, "consts", [])
+                    ])
+        if walked:
+            return [TOP] * len(eqn.outvars)
+        out = _transfer(eqn, ins)
+        return [out] * len(eqn.outvars)
+
+
+def jaxpr_fingerprint(closed) -> str:
+    """Stable fingerprint of a traced core (the P2 staleness key)."""
+    return hashlib.sha256(str(closed.jaxpr).encode("utf-8")).hexdigest()[:12]
+
+
+def analyze_case(case: IRCase) -> Analysis:
+    """P1 for one built core: trace, walk, classify, certify demotions."""
+    closed = _trace_jaxpr(case, x64=case.allow_f64 and case.x64_trace)
+    interp = _Interp()
+    ranges = case.arg_ranges or (None,) * len(case.args)
+    flat_in: List[AbsVal] = []
+    flat_map: List[int] = []  # flat position -> original arg index
+    import jax
+
+    for i, a in enumerate(case.args):
+        leaves = jax.tree_util.tree_leaves(a)
+        for _ in leaves:
+            flat_in.append(_range_absval(ranges[i] if i < len(ranges) else None))
+            flat_map.append(i)
+    consts = [_const_absval(c) for c in closed.consts]
+    outs = interp.eval_jaxpr(closed.jaxpr, flat_in, consts)
+
+    # input certification: nominated + declared exact + float32 + consumed
+    # only through promoting arithmetic (the walk pinned everything else)
+    arg_classes: List[str] = []
+    certified: List[int] = []
+    invars = closed.jaxpr.invars
+    for pos, var in enumerate(invars):
+        i = flat_map[pos] if pos < len(flat_map) else pos
+        aval = getattr(var, "aval", None)
+        dtype = str(getattr(aval, "dtype", ""))
+        if not dtype.startswith(("float", "bfloat")):
+            arg_classes.append("non_float")
+            continue
+        if dtype == "float64" and not getattr(aval, "weak_type", False):
+            arg_classes.append("f64_cert")
+            continue
+        av = flat_in[pos] if pos < len(flat_in) else TOP
+        nominated = i in tuple(case.prec_demote or ())
+        if nominated and av.exact and not case.allow_f64:
+            arg_classes.append("bf16_safe")
+            if i not in certified:
+                certified.append(i)
+        else:
+            arg_classes.append("f32_required")
+
+    out_rel: Optional[float] = 0.0
+    for pos, var in enumerate(closed.jaxpr.outvars):
+        dtype = str(getattr(getattr(var, "aval", None), "dtype", ""))
+        if not dtype.startswith(("float", "bfloat")):
+            continue
+        r = outs[pos].rel if pos < len(outs) else math.inf
+        if math.isinf(r) or r > _REL_CAP:
+            out_rel = None
+            break
+        out_rel = max(out_rel, r)
+
+    return Analysis(
+        classes=dict(interp.counts),
+        n_vars=interp.n_vars,
+        arg_classes=arg_classes,
+        certified_demote=sorted(certified),
+        out_rel=out_rel,
+        jaxpr_sha=jaxpr_fingerprint(closed),
+    )
+
+
+def chain_error_bound(fn, arg_specs, arg_ranges=None, static=None) -> Optional[float]:
+    """Static relative-error bound of ``fn``'s outputs (P1 walk), for the
+    bound-soundness property tests: the returned bound must dominate the
+    measured f32-vs-f64 relative error on any operands drawn inside
+    ``arg_ranges``. ``None`` = the walk could not bound the chain
+    (cancellation / fixpoint) — vacuously sound."""
+    case = IRCase(
+        fn=fn, args=tuple(arg_specs), static=dict(static or {}),
+        arg_ranges=tuple(arg_ranges) if arg_ranges is not None else None,
+    )
+    import jax
+
+    closed = jax.make_jaxpr(
+        (lambda *a: fn(*a, **case.static)) if case.static else fn
+    )(*case.args)
+    interp = _Interp()
+    ranges = case.arg_ranges or (None,) * len(case.args)
+    flat_in = [
+        _range_absval(ranges[i] if i < len(ranges) else None)
+        for i in range(len(case.args))
+    ]
+    consts = [_const_absval(c) for c in closed.consts]
+    outs = interp.eval_jaxpr(closed.jaxpr, flat_in, consts)
+    worst = 0.0
+    for av in outs:
+        if math.isinf(av.rel) or av.rel > _REL_CAP:
+            return None
+        worst = max(worst, av.rel)
+    return worst
+
+
+# --- traffic model -----------------------------------------------------------
+
+
+def _leaf_bytes(a, itemsize: Optional[int] = None) -> int:
+    import numpy as np
+
+    shape = tuple(getattr(a, "shape", ()) or ())
+    dtype = getattr(a, "dtype", None)
+    if dtype is None:
+        return 0
+    size = int(np.dtype(dtype).itemsize) if itemsize is None else itemsize
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * size
+
+
+def traffic_model(case: IRCase, demote_args: Sequence[int]) -> Dict[str, Any]:
+    """Static operand-bytes model of the demotion: committed-dtype input
+    bytes vs the same inputs with the certified arguments at bf16. This is
+    the jaxpr-level HBM-traffic evidence — deliberately NOT the XLA:CPU
+    cost model, which re-upcasts bf16 through f32 converts and would report
+    a traffic *increase* on the CI host (the recorded hardware waiver)."""
+    import jax
+
+    base = 0
+    demoted = 0
+    dem = set(int(i) for i in demote_args)
+    for i, a in enumerate(case.args):
+        for leaf in jax.tree_util.tree_leaves(a):
+            b = _leaf_bytes(leaf)
+            base += b
+            if i in dem:
+                dt = str(getattr(leaf, "dtype", ""))
+                if dt == "float32":
+                    b = b // 2
+            demoted += b
+    pct = 100.0 * (base - demoted) / base if base else 0.0
+    return {
+        "operand_bytes_f32": int(base),
+        "operand_bytes_demoted": int(demoted),
+        "reduction_pct": round(pct, 2),
+    }
+
+
+# --- P3: compiled truth ------------------------------------------------------
+
+
+import re  # noqa: E402
+
+_PARAM_RE = re.compile(r"=\s*([a-z0-9]+)\[[^\]]*\][^\n]*?\bparameter\((\d+)\)")
+
+
+def hlo_param_dtypes(text: str) -> Dict[int, str]:
+    """``{parameter index: dtype token}`` from compiled-HLO text."""
+    out: Dict[int, str] = {}
+    for m in _PARAM_RE.finditer(text):
+        out[int(m.group(2))] = m.group(1)
+    return out
+
+
+def hlo_dtype_census(text: str) -> Dict[str, int]:
+    """Occurrence counts of the floating dtype tokens in compiled HLO."""
+    return {
+        dt: len(re.findall(rf"(?<![\w]){dt}\[", text))
+        for dt in ("bf16", "f16", "f32", "f64")
+    }
+
+
+def demoted_args(case: IRCase, demote: Sequence[int]):
+    """The example args with the certified arguments at bf16."""
+    import jax
+    import jax.numpy as jnp
+
+    dem = set(int(i) for i in demote)
+    out = []
+    for i, a in enumerate(case.args):
+        if i not in dem:
+            out.append(a)
+            continue
+
+        def to16(leaf):
+            dt = str(getattr(leaf, "dtype", ""))
+            if dt != "float32":
+                return leaf
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return jax.ShapeDtypeStruct(leaf.shape, jnp.bfloat16)
+            return jnp.asarray(leaf).astype(jnp.bfloat16)
+
+        out.append(jax.tree_util.tree_map(to16, a))
+    return tuple(out)
+
+
+# --- per-core verification ---------------------------------------------------
+
+
+@dataclasses.dataclass
+class PrecCoreReport:
+    """graftgrade outcome for one registered core."""
+
+    name: str
+    path: str
+    line: int
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+    analysis: Optional[Analysis] = None
+    plan_entry: Optional[Dict[str, Any]] = None
+    #: committed-plan demotions this run verified at the compiled level
+    applied_demote: List[int] = dataclasses.field(default_factory=list)
+    traffic: Optional[Dict[str, Any]] = None
+    census: Optional[Dict[str, int]] = None
+    cert_isolated: Optional[bool] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+@dataclasses.dataclass
+class PrecReport:
+    cores: List[PrecCoreReport]
+    plan_path: str
+    updated: bool = False
+
+    @property
+    def violations(self) -> List[Violation]:
+        return [v for c in self.cores for v in c.violations]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def _viol(entry, rule: str, name: str, message: str) -> Violation:
+    return Violation(
+        path=entry.path, line=entry.line, col=0, rule=rule, name=name,
+        message=f"[{entry.name}] {message}",
+    )
+
+
+def measured_plan_entry(analysis: Analysis, case: IRCase) -> Dict[str, Any]:
+    """The PRECISION_PLAN.json entry this run would commit for one core."""
+    return {
+        "jaxpr_sha": analysis.jaxpr_sha,
+        "classes": dict(analysis.classes),
+        "n_vars": analysis.n_vars,
+        "demote_args": list(analysis.certified_demote),
+        "out_rel_bound": analysis.out_rel,
+        "traffic": traffic_model(case, analysis.certified_demote),
+    }
+
+
+def verify_prec_core(
+    entry: CoreEntry,
+    plan_entry: Optional[Dict[str, Any]],
+    update_plan: bool = False,
+) -> PrecCoreReport:
+    """Run P1–P3 for one registered core; check failures become violations,
+    never exceptions."""
+    report = PrecCoreReport(name=entry.name, path=entry.path, line=entry.line)
+    report.plan_entry = plan_entry
+    try:
+        case = entry.build()
+    except Exception as exc:  # noqa: BLE001 - reported, not raised
+        report.violations.append(
+            _viol(entry, "P1", "untraceable-core", f"builder failed: {exc!r}")
+        )
+        return report
+    report._case = case  # type: ignore[attr-defined]  # for the plan writer
+
+    # --- P1 ------------------------------------------------------------------
+    try:
+        analysis = analyze_case(case)
+    except Exception as exc:  # noqa: BLE001
+        report.violations.append(
+            _viol(entry, "P1", "untraceable-core", f"error-flow walk failed: {exc!r}")
+        )
+        return report
+    report.analysis = analysis
+
+    nominated = set(int(i) for i in (case.prec_demote or ()))
+    refused = sorted(nominated - set(analysis.certified_demote))
+    if refused:
+        report.violations.append(
+            _viol(
+                entry, "P1", "uncertified-demotion",
+                f"argument(s) {refused} are nominated in prec_demote but the "
+                "error-flow walk refuses them — declare an exact arg_ranges "
+                "triple the operand actually satisfies, or drop the nomination",
+            )
+        )
+
+    # --- P2: the ratchet -----------------------------------------------------
+    if plan_entry is None:
+        report.violations.append(
+            _viol(
+                entry, "P2", "missing-plan-entry",
+                "no entry in PRECISION_PLAN.json — run 'python -m "
+                "citizensassemblies_tpu.lint --prec --update-prec-plan' and "
+                "commit the result",
+            )
+        )
+        if not update_plan:
+            return report
+        plan_demote: List[int] = list(analysis.certified_demote)
+    else:
+        if str(plan_entry.get("jaxpr_sha")) != analysis.jaxpr_sha:
+            report.violations.append(
+                _viol(
+                    entry, "P2", "stale-plan-entry",
+                    f"committed jaxpr fingerprint {plan_entry.get('jaxpr_sha')} "
+                    f"!= traced {analysis.jaxpr_sha} — the core changed under "
+                    "the plan; re-certify with --update-prec-plan",
+                )
+            )
+        plan_classes = dict(plan_entry.get("classes", {}))
+        plan_n = int(plan_entry.get("n_vars", -1))
+        if (
+            plan_n != analysis.n_vars
+            or sum(int(v) for v in plan_classes.values()) != plan_n
+        ):
+            report.violations.append(
+                _viol(
+                    entry, "P2", "unclassified-var",
+                    f"plan classes cover {sum(int(v) for v in plan_classes.values())} "
+                    f"of n_vars={plan_n} vs {analysis.n_vars} traced variables "
+                    "— every intermediate must carry a classification; "
+                    "re-certify with --update-prec-plan",
+                )
+            )
+        if int(plan_classes.get("bf16_safe", 0)) > analysis.classes["bf16_safe"]:
+            report.violations.append(
+                _viol(
+                    entry, "P2", "plan-downgrade",
+                    f"plan claims {plan_classes.get('bf16_safe')} bf16_safe "
+                    f"intermediates but the walk certifies only "
+                    f"{analysis.classes['bf16_safe']} — a downgraded entry "
+                    "(someone widened the plan without re-certifying)",
+                )
+            )
+        plan_demote = [int(i) for i in plan_entry.get("demote_args", [])]
+        over = sorted(set(plan_demote) - set(analysis.certified_demote))
+        if over:
+            rule_name = (
+                "bf16-into-cert-sink" if case.allow_f64 else "plan-downgrade"
+            )
+            msg = (
+                f"plan demotes argument(s) {over} of a float64 certification "
+                "core — bf16 must never reach an f64_cert sink"
+                if case.allow_f64
+                else f"plan demotes argument(s) {over} the walk does not "
+                "certify — a downgraded entry; re-certify with "
+                "--update-prec-plan"
+            )
+            report.violations.append(_viol(entry, "P2", rule_name, msg))
+
+    # --- P3: compiled truth of the APPLIED plan ------------------------------
+    applied = sorted(set(plan_demote) & set(analysis.certified_demote))
+    report.applied_demote = applied
+    report.traffic = traffic_model(case, applied)
+    try:
+        if applied:
+            args16 = demoted_args(case, applied)
+            hlo = case.fn.lower(*args16, **case.static).compile().as_text()
+            closed16 = _trace_jaxpr(
+                dataclasses.replace(case, args=args16),
+                x64=case.allow_f64 and case.x64_trace,
+            )
+        else:
+            hlo = case.fn.lower(*case.args, **case.static).compile().as_text()
+            closed16 = None
+    except Exception as exc:  # noqa: BLE001
+        report.violations.append(
+            _viol(entry, "P3", "uncompilable-core", f"demoted lower/compile failed: {exc!r}")
+        )
+        return report
+    report.census = hlo_dtype_census(hlo)
+    if applied:
+        params = hlo_param_dtypes(hlo)
+        import jax
+
+        # flat parameter positions of the demoted args (pytree leaves)
+        flat_pos = 0
+        for i, a in enumerate(case.args):
+            for leaf in jax.tree_util.tree_leaves(a):
+                if i in applied and str(getattr(leaf, "dtype", "")) == "float32":
+                    got = params.get(flat_pos)
+                    if got is not None and got != "bf16":
+                        report.violations.append(
+                            _viol(
+                                entry, "P3", "silent-upcast",
+                                f"demoted argument {i} (parameter {flat_pos}) "
+                                f"lowers to {got} in the compiled HLO — XLA "
+                                "re-upcast the demoted edge; the plan's bytes "
+                                "saving is fictional for this core",
+                            )
+                        )
+                flat_pos += 1
+        if report.census.get("bf16", 0) == 0:
+            report.violations.append(
+                _viol(
+                    entry, "P3", "silent-upcast",
+                    "no bf16 appears anywhere in the demoted core's compiled "
+                    "HLO — the demotion was erased before codegen",
+                )
+            )
+        if closed16 is not None:
+            flow = precision_flow(closed16.jaxpr)
+            report.cert_isolated = bool(flow.get("cert_isolated", True))
+            if not report.cert_isolated:
+                report.violations.append(
+                    _viol(
+                        entry, "P3", "bf16-into-cert-sink",
+                        "the demoted trace feeds a bf16-safe value into the "
+                        "float64 certification arithmetic (precision_flow "
+                        "cert_isolated=False)",
+                    )
+                )
+    if case.allow_f64 and report.census is not None:
+        n16 = report.census.get("bf16", 0) + report.census.get("f16", 0)
+        if n16 > 0:
+            report.violations.append(
+                _viol(
+                    entry, "P3", "bf16-into-cert-sink",
+                    f"{n16} half-precision tensor(s) in the compiled HLO of a "
+                    "float64 certification core — cert arithmetic must stay "
+                    "untouched by the mixed-precision lowering",
+                )
+            )
+    return report
+
+
+# --- plan file ---------------------------------------------------------------
+
+
+def load_prec_plan(path: Path) -> Dict[str, Any]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return dict(data.get("cores", {}))
+
+
+def write_prec_plan(path: Path, reports: Sequence[PrecCoreReport]) -> None:
+    import jax
+
+    data = {
+        "_meta": {
+            "schema_version": 1,
+            "jax": jax.__version__,
+            "classes": ["bf16_safe", "f32_required", "f64_cert", "non_float"],
+            "generated_by": (
+                "python -m citizensassemblies_tpu.lint --prec "
+                "--update-prec-plan"
+            ),
+        },
+        "cores": {
+            r.name: measured_plan_entry(r.analysis, r._case)  # type: ignore[attr-defined]
+            for r in reports
+            if r.analysis is not None and hasattr(r, "_case")
+        },
+    }
+    path.write_text(
+        json.dumps(data, indent=1, sort_keys=True) + "\n", encoding="utf-8"
+    )
+
+
+def prec_plan_provenance(path: Optional[Path] = None) -> Dict[str, Any]:
+    """Compact provenance of the committed precision plan, for bench rows —
+    the same attribution contract as ``ir.budget_provenance``."""
+    path = path or PLAN_PATH
+    if not path.exists():
+        return {"file": path.name, "missing": True}
+    raw = path.read_bytes()
+    data = json.loads(raw.decode("utf-8"))
+    cores = data.get("cores", {})
+    return {
+        "file": path.name,
+        "sha256": hashlib.sha256(raw).hexdigest()[:12],
+        "cores": len(cores),
+        "demoted": sum(1 for c in cores.values() if c.get("demote_args")),
+        "jax": data.get("_meta", {}).get("jax"),
+    }
+
+
+# --- the pass ----------------------------------------------------------------
+
+
+def run_prec_checks(
+    entries: Optional[Sequence[CoreEntry]] = None,
+    plan_path: Optional[Path] = None,
+    update_plan: bool = False,
+) -> PrecReport:
+    """Certify every registered core (or ``entries``) against the committed
+    precision plan. ``update_plan=True`` re-certifies and REWRITES the plan
+    (the deliberate ratchet move); P2 violations are then dropped — the new
+    plan is the certification — while P1/P3 still fail."""
+    plan_path = Path(plan_path) if plan_path is not None else PLAN_PATH
+    entries = list(entries) if entries is not None else collect()
+    plan = load_prec_plan(plan_path)
+
+    reports: List[PrecCoreReport] = []
+    for e in entries:
+        reports.append(
+            verify_prec_core(e, plan.get(e.name), update_plan=update_plan)
+        )
+
+    if update_plan:
+        write_prec_plan(plan_path, reports)
+        for rep in reports:
+            rep.violations = [v for v in rep.violations if v.rule != "P2"]
+    else:
+        known = {e.name for e in entries}
+        for name in sorted(set(plan) - known):
+            reports.append(
+                PrecCoreReport(
+                    name=name,
+                    path=str(plan_path.name),
+                    line=1,
+                    violations=[
+                        Violation(
+                            path=str(plan_path.name), line=1, col=0,
+                            rule="P2", name="stale-plan-entry",
+                            message=(
+                                f"[{name}] precision-plan entry has no "
+                                "registered core — remove it via "
+                                "--update-prec-plan"
+                            ),
+                        )
+                    ],
+                )
+            )
+
+    return PrecReport(
+        cores=reports, plan_path=str(plan_path), updated=update_plan
+    )
+
+
+def prec_plan_diff(report: PrecReport) -> Dict[str, Any]:
+    """Measured-vs-plan comparison for the CI build artifact
+    (``PRECISION_PLAN_DIFF.json``), with the per-core traffic table — the
+    HBM-reduction evidence rows the acceptance gate reads."""
+    plan = load_prec_plan(Path(report.plan_path))
+    cores: Dict[str, Any] = {}
+    traffic: Dict[str, Any] = {}
+    for rep in report.cores:
+        entry: Dict[str, Any] = {"status": "PASS" if rep.ok else "FAIL"}
+        if rep.analysis is not None:
+            entry["measured"] = {
+                "jaxpr_sha": rep.analysis.jaxpr_sha,
+                "classes": rep.analysis.classes,
+                "n_vars": rep.analysis.n_vars,
+                "certified_demote": rep.analysis.certified_demote,
+            }
+            committed = plan.get(rep.name)
+            if committed:
+                entry["plan"] = committed
+        cores[rep.name] = entry
+        if rep.traffic is not None and rep.applied_demote:
+            traffic[rep.name] = {
+                **rep.traffic, "demote_args": rep.applied_demote,
+            }
+    big = sum(
+        1 for t in traffic.values() if t.get("reduction_pct", 0) >= 25.0
+    )
+    return {
+        "plan_file": report.plan_path,
+        "provenance": prec_plan_provenance(Path(report.plan_path)),
+        "traffic": traffic,
+        "cores_over_25pct": big,
+        "waiver": (
+            "operand-bytes model at the jaxpr level; XLA:CPU legalizes bf16 "
+            "through f32 converts, so the compiled CPU cost model would show "
+            "an increase — the bytes win is realized on TPU/GPU HBM"
+        ),
+        "cores": cores,
+    }
+
+
+def render_prec_report(report: PrecReport) -> str:
+    """graftlint-style text: violations in file:line form, then per-core
+    PASS/FAIL lines, then the summary tail."""
+    lines = [v.render() for v in report.violations]
+    for rep in sorted(report.cores, key=lambda r: r.name):
+        status = "PASS" if rep.ok else "FAIL"
+        extra = ""
+        if rep.analysis is not None:
+            c = rep.analysis.classes
+            extra = (
+                f" (bf16_safe={c['bf16_safe']} f32_required={c['f32_required']}"
+                f" f64_cert={c['f64_cert']}"
+            )
+            if rep.applied_demote:
+                extra += (
+                    f", demoted args {rep.applied_demote}"
+                    f" -{rep.traffic['reduction_pct']}% bytes"
+                )
+            extra += ")"
+        lines.append(f"{rep.path}:{rep.line}: {status} [{rep.name}]{extra}")
+    n_fail = sum(1 for r in report.cores if not r.ok)
+    n_dem = sum(1 for r in report.cores if r.applied_demote)
+    lines.append(
+        f"graftgrade: {len(report.cores)} core(s) certified, {n_dem} demoted, "
+        f"{n_fail} failing, plan={report.plan_path}"
+        + (" (updated)" if report.updated else "")
+    )
+    return "\n".join(lines)
+
+
+def prec_report_as_json(report: PrecReport) -> Dict[str, Any]:
+    """Stable JSON schema shared with the AST/IR/SPMD passes; folds the S3
+    ``cert_isolated`` verdicts in so the scope-level and compiled-truth
+    views cannot drift apart."""
+    return {
+        "schema_version": 1,
+        "pass": "prec",
+        "ok": report.ok,
+        "plan": report.plan_path,
+        "updated": report.updated,
+        "cores": [
+            {
+                "core": rep.name,
+                "path": rep.path,
+                "line": rep.line,
+                "status": "PASS" if rep.ok else "FAIL",
+                "classes": rep.analysis.classes if rep.analysis else None,
+                "demote_args": rep.applied_demote,
+                "traffic": rep.traffic,
+                "census": rep.census,
+                "cert_isolated": rep.cert_isolated,
+            }
+            for rep in sorted(report.cores, key=lambda r: r.name)
+        ],
+        "violations": [dataclasses.asdict(v) for v in report.violations],
+    }

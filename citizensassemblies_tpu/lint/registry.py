@@ -52,6 +52,18 @@ class IRCase:
     compiler actually emits; an *undeclared* operand above the
     ``spmd_replicated_bytes_max`` threshold is flagged as an implicitly
     replicated mega-operand.
+
+    ``arg_ranges``/``prec_demote`` are the graftgrade P1 contract
+    (``lint/prec.py``): ``arg_ranges`` seeds the error-flow abstract
+    interpretation with one ``(lo, hi, exact)`` triple per argument —
+    ``exact=True`` declares the operand's concrete values are exactly
+    representable at bf16 (small-integer composition/constraint entries;
+    the runtime ``demote_operator`` round-trip enforces it per array) —
+    ``None`` for an argument with no declared range (seeded wide,
+    inexact). ``prec_demote`` lists the argument indices the registration
+    NOMINATES for bf16 operand demotion; graftgrade certifies (or
+    refuses) each nomination and the committed PRECISION_PLAN.json is
+    what the runtime actually applies.
     """
 
     fn: Any
@@ -61,6 +73,8 @@ class IRCase:
     allow_f64: bool = False
     x64_trace: bool = True
     arg_roles: Optional[Tuple[Optional[str], ...]] = None
+    arg_ranges: Optional[Tuple[Optional[Tuple[float, float, bool]], ...]] = None
+    prec_demote: Tuple[int, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

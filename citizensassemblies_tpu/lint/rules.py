@@ -1749,3 +1749,130 @@ class ShardingSpecHygieneRule:
                             )
                         )
         return out
+
+# --- R13: dtype-literal hygiene of the mixed-precision hot paths -------------
+
+
+class DtypeLiteralHygieneRule:
+    """R13 — precision policy lives in ``utils/precision.py``, nowhere else.
+
+    graftgrade's whole contract is that the COMMITTED plan decides what runs
+    at bf16: the certifier walks the jaxpr, the ratchet records the verdict,
+    the runtime applies it through ``demote_operator``. A raw 16-bit dtype
+    spelled at a call site bypasses all three — an uncertified demotion the
+    plan never sees — and an operand-derived ``dtype=`` in a solver hot path
+    is the dual failure: once any operand legitimately rides at bf16, a
+    ``jnp.ones(n, dtype=K.dtype)`` iterate silently inherits it and the
+    1e-6 KKT tolerance becomes unreachable (8 significand bits resolve
+    ~4e-3). Two findings, scoped to the ``solvers/``/``kernels/`` hot paths:
+
+    * **Raw 16-bit dtype literals.** ``jnp.bfloat16`` / ``jnp.float16`` /
+      ``np.float16`` attribute references and ``"bfloat16"``/``"float16"``/
+      ``"bf16"`` dtype strings anywhere outside the precision-policy module
+      — route through ``utils/precision.demote_dtype`` so the demotion is
+      the certified one.
+    * **Operand-derived dtype policy.** A ``dtype=<expr>.dtype`` keyword or
+      a ``name = <expr>.dtype`` policy assignment not wrapped in
+      ``iterate_dtype(...)`` — the floor-at-f32 helper is what keeps
+      iterates, scaling vectors and while-carry dtypes convergence-safe
+      when the operand itself is demoted.
+
+    Test modules are exempt (fixtures construct half-precision operands on
+    purpose), as are the R4 float64 certification modules (host numpy
+    arithmetic, no demotion surface).
+    """
+
+    rule_id = "R13"
+    name = "dtype-literal-hygiene"
+    description = "raw 16-bit dtype literals / un-floored operand-derived dtype= in solver hot paths"
+
+    #: the one module allowed to spell the demotion target
+    _POLICY_SUFFIX = "utils/precision.py"
+    _HALF_ATTRS = frozenset({"bfloat16", "float16"})
+    _HALF_STRS = frozenset({"bfloat16", "float16", "bf16", "f16"})
+
+    @staticmethod
+    def _in_scope(mod: ModuleSource) -> bool:
+        rel = mod.rel.replace("\\", "/")
+        name = mod.path.name
+        if (
+            "tests" in mod.path.parts
+            or name.startswith("test_")
+            or name == "conftest.py"
+        ):
+            return False
+        if any(rel.endswith(w) for w in DtypeDisciplineRule._F64_WHITELIST):
+            return False
+        return "solvers/" in rel or "kernels/" in rel
+
+    def check_module(self, mod: ModuleSource) -> List[Violation]:
+        rel = mod.rel.replace("\\", "/")
+        if rel.endswith(self._POLICY_SUFFIX) or not self._in_scope(mod):
+            return []
+        jnp = jnp_aliases(mod.tree)
+        np_alias = numpy_aliases(mod.tree)
+        out: List[Violation] = []
+
+        def viol(node: ast.AST, msg: str) -> None:
+            out.append(
+                Violation(
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    rule=self.rule_id, name=self.name, message=msg,
+                )
+            )
+
+        def is_dtype_attr(node: ast.AST) -> bool:
+            return isinstance(node, ast.Attribute) and node.attr == "dtype"
+
+        for node in ast.walk(mod.tree):
+            # finding A: raw 16-bit dtype literals
+            if isinstance(node, ast.Attribute) and node.attr in self._HALF_ATTRS:
+                base = dotted(node.value)
+                if base is not None and (base in jnp or base in np_alias):
+                    viol(
+                        node,
+                        f"raw {node.attr} literal in a solver/kernel hot "
+                        "path bypasses the graftgrade plan — only "
+                        "utils/precision.py spells the demotion target "
+                        "(demote_operator applies the certified plan)",
+                    )
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    if (
+                        isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                        and kw.value.value in self._HALF_STRS
+                    ):
+                        viol(
+                            node,
+                            f'dtype="{kw.value.value}" literal in a '
+                            "solver/kernel hot path bypasses the graftgrade "
+                            "plan — route through utils/precision.py",
+                        )
+                    # finding B1: operand-derived dtype= kwarg, un-floored
+                    if is_dtype_attr(kw.value):
+                        viol(
+                            node,
+                            f"operand-derived dtype={dotted(kw.value)} in a "
+                            "hot path: once the plan demotes that operand, "
+                            "iterates built from it inherit bf16 and the "
+                            "KKT tolerance becomes unreachable — wrap in "
+                            "utils/precision.iterate_dtype(...) to floor at "
+                            "f32",
+                        )
+            # finding B2: a dtype POLICY assignment (a wrapped
+            # iterate_dtype(...) value is a Call, not an Attribute, so the
+            # floored form never matches)
+            if isinstance(node, ast.Assign) and is_dtype_attr(node.value):
+                tgt = node.targets[0]
+                tname = tgt.id if isinstance(tgt, ast.Name) else "?"
+                viol(
+                    node,
+                    f"dtype policy assignment {tname} = "
+                    f"{dotted(node.value)} is un-floored: every array "
+                    "built with it follows the operand down to bf16 — "
+                    "wrap in utils/precision.iterate_dtype(...)",
+                )
+        return out

@@ -70,7 +70,9 @@ SPMD_BUDGET_PATH = (
 
 #: the committed precision-flow artifact (S3)
 PRECISION_FLOW_PATH = (
-    Path(__file__).resolve().parent.parent.parent / "PRECISION_FLOW.json"
+    Path(__file__).resolve().parent.parent.parent
+    / "artifacts"
+    / "PRECISION_FLOW.json"
 )
 
 #: the virtual mesh sizes the SPMD registrations are swept across

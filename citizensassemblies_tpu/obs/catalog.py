@@ -78,6 +78,9 @@ METRIC_SERIES: Dict[str, str] = {
     "sparse_fill_pct": "ELL pack fill ratio (percent, gauge)",
     "sparse_hit": "solves routed through the ELL sparse cores",
     "sparse_miss": "solves that fell back to the dense cores",
+    # --- graftgrade mixed precision (utils/precision.py) -----------------
+    "mp_demoted_operands": "operator matrices demoted to bf16 under the certified plan",
+    "mp_lossy_skip": "plan-certified demotions skipped by the lossless round-trip check",
     # --- megakernel (kernels/pdhg_megakernel.py) -------------------------
     "megakernel_dispatches": "fused PDHG megakernel dispatches",
     "megakernel_lanes": "polish-screen lanes carried by megakernel dispatches",

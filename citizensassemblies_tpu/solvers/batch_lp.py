@@ -62,6 +62,7 @@ from citizensassemblies_tpu.lint.registry import (
     register_spmd_core,
 )
 from citizensassemblies_tpu.obs.hooks import dispatch_span
+from citizensassemblies_tpu.utils.precision import demote_operator
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import CompilationGuard, no_implicit_transfers
 from citizensassemblies_tpu.utils.memo import LRU
@@ -267,6 +268,18 @@ def _ir_batch_core() -> IRCase:
             S((B, nv), f32), S((B, m1), f32), S((B, m2), f32), S((B,), f32),
         ),
         donate_expected=3,  # the stacked x0/lam0/mu0 carries
+        arg_ranges=(
+            (-1e4, 1e4, False),
+            (0.0, 256.0, True),
+            (-1e4, 1e4, False),
+            (0.0, 256.0, True),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(1, 3),  # stacked G, A
     )
 
 
@@ -520,6 +533,22 @@ def solve_lp_batch(
             operands = tuple(
                 jnp.asarray(a) for a in (c, G, h, A, b, x0, lam0, mu0, tols)
             )
+        if mesh is None or int(mesh.devices.size) <= 1:
+            # graftgrade: the stacked constraint matrices ride at bf16 when
+            # the committed plan certifies them (single-device route only —
+            # the mesh layouts keep their declared f32 partition specs)
+            operands = (
+                operands[0],
+                demote_operator(
+                    operands[1], cfg, core="batch_lp.vmapped_core", arg=1,
+                    log=log,
+                ),
+                operands[2],
+                demote_operator(
+                    operands[3], cfg, core="batch_lp.vmapped_core", arg=3,
+                    log=log,
+                ),
+            ) + operands[4:]
         with dispatch_span(
             "batch_lp.vmapped_core", cfg=cfg, log=log, bucket=bkey,
             lanes=int(B_real),

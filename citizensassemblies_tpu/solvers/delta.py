@@ -64,6 +64,7 @@ from citizensassemblies_tpu.solvers.compositions import (
 )
 from citizensassemblies_tpu.solvers.sparse_ops import EllPack
 from citizensassemblies_tpu.utils.guards import no_implicit_transfers
+from citizensassemblies_tpu.utils.precision import iterate_dtype
 from citizensassemblies_tpu.utils.logging import RunLog
 
 #: the framework's hard L∞ exactness contract (``models/leximin.py``)
@@ -293,7 +294,7 @@ def _get_screen_core():
             ok_cap = jnp.all(val <= mv + 0.5, axis=1)
             F = lo.shape[0]
             feat = tfeat[idx]  # [C, P, ncat]
-            onehot = jax.nn.one_hot(feat, F, dtype=val.dtype)  # [C, P, ncat, F]
+            onehot = jax.nn.one_hot(feat, F, dtype=iterate_dtype(val.dtype))  # [C, P, ncat, F]
             counts = jnp.einsum("cp,cpjf->cf", val, onehot)  # [C, F]
             ok_band = jnp.all(
                 (counts >= lo[None, :] - 0.5) & (counts <= hi[None, :] + 0.5),

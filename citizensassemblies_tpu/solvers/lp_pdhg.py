@@ -38,6 +38,7 @@ from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.config import Config, default_config
 from citizensassemblies_tpu.utils.guards import no_implicit_transfers
+from citizensassemblies_tpu.utils.precision import demote_operator, iterate_dtype
 
 
 @dataclasses.dataclass
@@ -162,8 +163,8 @@ def _ruiz_equilibrate(K: jnp.ndarray, iters: int = 8) -> Tuple[jnp.ndarray, jnp.
     """Diagonal row/column scalings d_r, d_c with D_r K D_c ≈ unit row/col
     ∞-norms (Ruiz 2001). Returns (d_r[m], d_c[nv])."""
     m, nv = K.shape
-    d_r = jnp.ones(m, dtype=K.dtype)
-    d_c = jnp.ones(nv, dtype=K.dtype)
+    d_r = jnp.ones(m, dtype=iterate_dtype(K.dtype))
+    d_c = jnp.ones(nv, dtype=iterate_dtype(K.dtype))
 
     def body(_, carry):
         d_r, d_c = carry
@@ -183,7 +184,7 @@ def _ruiz_equilibrate(K: jnp.ndarray, iters: int = 8) -> Tuple[jnp.ndarray, jnp.
 
 def _power_norm(K: jnp.ndarray, iters: int = 40) -> jnp.ndarray:
     """Estimate ‖K‖₂ by power iteration on KᵀK."""
-    v = jnp.ones(K.shape[1], dtype=K.dtype) / jnp.sqrt(K.shape[1])
+    v = jnp.ones(K.shape[1], dtype=iterate_dtype(K.dtype)) / jnp.sqrt(K.shape[1])
 
     def body(_, v):
         w = K.T @ (K @ v)
@@ -394,6 +395,11 @@ def solve_lp(
     # tol would itself be an implicit transfer); inside the guard a stray
     # numpy operand re-uploaded per CG round raises
     tol_ = jnp.asarray(tol, jnp.float32)
+    # graftgrade: the read-only operator matrices ride at bf16 when the
+    # committed plan certifies them (lossless round-trip only, so the core's
+    # f32 arithmetic is bit-identical after the first promote)
+    G_ = demote_operator(G_, cfg, core="lp_pdhg.pdhg_core", arg=1, log=log)
+    A_ = demote_operator(A_, cfg, core="lp_pdhg.pdhg_core", arg=3, log=log)
     with dispatch_span(
         "lp_pdhg.pdhg_core", cfg=cfg, nv=int(nv), m1=int(m1), m2=int(m2)
     ) as _ds:
@@ -449,7 +455,7 @@ def _two_sided_iterate(
     math. Inputs arrive in SCALED coordinates; returns the final scaled
     iterates plus ``(iters, res)``. The op sequence is exactly the dense
     core's original loop — the dense path stays bit-identical."""
-    f32 = p.dtype
+    f32 = iterate_dtype(p.dtype)
     C = p.shape[0]
 
     # power iteration for ‖K‖ via the structured matvecs
@@ -597,7 +603,7 @@ def _pdhg_two_sided_core(
     the generic core's row order.
     """
     T, C = MT.shape
-    f32 = MT.dtype
+    f32 = iterate_dtype(MT.dtype)
 
     # --- Ruiz equilibration on the structured system ------------------------
     # K's distinct row blocks: the T two-sided rows (magnitude |MT| plus the
@@ -704,7 +710,7 @@ def _pdhg_two_sided_body_ell(
 
     T = v.shape[0]
     C = colmask.shape[0]
-    f32 = val.dtype
+    f32 = iterate_dtype(val.dtype)
 
     # --- Ruiz equilibration on the packed rep -------------------------------
     # same four scales as the dense structured core; row maxima over the
@@ -896,7 +902,10 @@ def solve_two_sided_master_async(
     # the transfer guard counts as an implicit upload); inside the guard the
     # hot call may only touch what is already resident
     operands = (
-        jnp.asarray(MTp, f32),
+        demote_operator(
+            jnp.asarray(MTp, f32), cfg,
+            core="lp_pdhg.two_sided_core", arg=0, log=_ambient_log(),
+        ),
         jnp.asarray(v, f32),
         jnp.asarray(colmask, f32),
         jnp.asarray(x0, f32),
@@ -999,7 +1008,10 @@ def solve_two_sided_master_ell_async(
     # operands materialized BEFORE the guard scope, as in the dense wrapper
     operands = (
         jnp.asarray(idx_p),
-        jnp.asarray(val_p),
+        demote_operator(
+            jnp.asarray(val_p), cfg,
+            core="lp_pdhg.two_sided_core_ell", arg=1, log=_ambient_log(),
+        ),
         jnp.asarray(v, f32),
         jnp.asarray(colmask, f32),
         jnp.asarray(x0, f32),
@@ -1093,7 +1105,7 @@ def _pdhg_body_ell(
     m1 = idx.shape[0]
     nv = c.shape[0]
     m2 = A.shape[0]
-    f32 = val.dtype
+    f32 = iterate_dtype(val.dtype)
 
     # --- Ruiz on the stacked [G; A] system, G in packed form ----------------
     absV = jnp.abs(val)
@@ -1267,7 +1279,11 @@ def solve_lp_ell(
         x0_h[0] = np.nan
     x0, lam0, mu0 = jnp.asarray(x0_h), jnp.asarray(lam0_h), jnp.asarray(mu0_h)
     idx_d = jnp.asarray(ell.idx)
-    val_d = jnp.asarray(ell.val)
+    val_d = demote_operator(
+        jnp.asarray(ell.val), cfg, core="lp_pdhg.pdhg_core_ell", arg=2,
+        log=log,
+    )
+    A_ = demote_operator(A_, cfg, core="lp_pdhg.pdhg_core_ell", arg=4, log=log)
     tol_ = jnp.asarray(tol, jnp.float32)
     from citizensassemblies_tpu.kernels import pdhg_megakernel as _mk
 
@@ -1350,6 +1366,18 @@ def _ir_pdhg_core() -> IRCase:
         ),
         static=dict(max_iters=1024, check_every=128),
         donate_expected=3,  # x0, lam0, mu0
+        arg_ranges=(
+            (-1e4, 1e4, False),
+            (0.0, 256.0, True),
+            (-1e4, 1e4, False),
+            (0.0, 256.0, True),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(1, 3),  # G, A
     )
 
 
@@ -1371,6 +1399,19 @@ def _ir_pdhg_core_ell() -> IRCase:
         ),
         static=dict(max_iters=1024, check_every=128),
         donate_expected=3,  # x0, lam0, mu0
+        arg_ranges=(
+            (-1e4, 1e4, False),
+            None,
+            (0.0, 256.0, True),
+            (-1e4, 1e4, False),
+            (0.0, 256.0, True),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(2, 4),  # ELL values, A
     )
 
 
@@ -1389,6 +1430,16 @@ def _ir_two_sided_core() -> IRCase:
         ),
         static=dict(max_iters=1024, check_every=128),
         donate_expected=2,  # x0, lam0 (mu0 is a scalar, undonated by design)
+        arg_ranges=(
+            (0.0, 256.0, True),
+            (0.0, 1.0, False),
+            (0.0, 1.0, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(0,),  # MT
     )
 
 
@@ -1409,6 +1460,17 @@ def _ir_two_sided_core_ell() -> IRCase:
         ),
         static=dict(max_iters=1024, check_every=128),
         donate_expected=2,  # x0, lam0 (mu0 scalar, undonated by design)
+        arg_ranges=(
+            None,
+            (0.0, 256.0, True),
+            (0.0, 1.0, False),
+            (0.0, 1.0, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (-1e4, 1e4, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(1,),  # ELL values
     )
 
 

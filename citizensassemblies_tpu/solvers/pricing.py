@@ -24,6 +24,7 @@ import numpy as np
 from citizensassemblies_tpu.core.instance import DenseInstance
 from citizensassemblies_tpu.models.legacy import sample_panels_batch
 from citizensassemblies_tpu.utils.config import Config, default_config
+from citizensassemblies_tpu.utils.precision import iterate_dtype
 
 
 def beta_ladder(batch: int, lo: float = -1.0, hi: float = 3.5) -> np.ndarray:
@@ -41,7 +42,7 @@ def beta_ladder(batch: int, lo: float = -1.0, hi: float = 3.5) -> np.ndarray:
 def _pricing_scores(weights: jnp.ndarray, batch: int) -> jnp.ndarray:
     """[B, n] member-pick scores: β_b · ŵ with the log-spaced β ladder."""
     w = weights / (jnp.max(jnp.abs(weights)) + 1e-12)
-    betas = jnp.asarray(beta_ladder(batch), dtype=w.dtype)
+    betas = jnp.asarray(beta_ladder(batch), dtype=iterate_dtype(w.dtype))
     return betas[:, None] * w[None, :]
 
 

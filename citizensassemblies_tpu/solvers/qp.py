@@ -36,6 +36,7 @@ import numpy as np
 from citizensassemblies_tpu.lint.registry import IRCase, register_ir_core
 from citizensassemblies_tpu.obs.hooks import dispatch_span
 from citizensassemblies_tpu.utils.memo import LRU
+from citizensassemblies_tpu.utils.precision import demote_operator, iterate_dtype
 
 
 @jax.jit
@@ -44,7 +45,7 @@ def project_simplex(v: jnp.ndarray) -> jnp.ndarray:
     d = v.shape[0]
     u = jnp.sort(v)[::-1]
     css = jnp.cumsum(u) - 1.0
-    idx = jnp.arange(1, d + 1, dtype=v.dtype)
+    idx = jnp.arange(1, d + 1, dtype=iterate_dtype(v.dtype))
     cond = u - css / idx > 0
     rho = jnp.sum(cond.astype(jnp.int32)) - 1
     theta = css[rho] / (rho + 1).astype(v.dtype)
@@ -116,7 +117,7 @@ def _ell_power_norm(idx, val, n: int, iters: int = 40):
         ell_scatter_mv,
     )
 
-    v = jnp.ones(n, dtype=val.dtype) / jnp.sqrt(jnp.float32(n))
+    v = jnp.ones(n, dtype=iterate_dtype(val.dtype)) / jnp.sqrt(jnp.float32(n))
 
     def body(_, v):
         w = ell_scatter_mv(idx, val, ell_gather_mv(idx, val, v), n)
@@ -171,7 +172,7 @@ def _get_l2_fused_core(
 
     @jax.jit
     def fused(P, t, p_don, eps_margin, eps_tol, ascent_tol):
-        f32 = P.dtype
+        f32 = iterate_dtype(P.dtype)
         C, n = P.shape
         PT = P.T
         # --- stage 1: min-ε anchor on the recovery LP (same generic PDHG
@@ -302,7 +303,7 @@ def _get_l2_fused_core_ell(
 
     @jax.jit
     def fused(idx, val, t, p_don, eps_margin, eps_tol, ascent_tol):
-        f32 = val.dtype
+        f32 = iterate_dtype(val.dtype)
         C = idx.shape[0]
         n = t.shape[0]
         # --- stage 1: min-ε anchor — the two-sided ε master over the
@@ -405,6 +406,14 @@ def _ir_dual_ascent() -> IRCase:
         args=(S((C, n), f32), S((n,), f32), S((), f32), S((), f32), S((2 * n,), f32)),
         static=dict(iters=2048),
         donate_expected=1,  # lam0
+        arg_ranges=(
+            (0.0, 256.0, True),
+            (0.0, 1.0, False),
+            (1e-8, 1e-2, False),
+            (0.0, 1.0, False),
+            (-1e4, 1e4, False),
+        ),
+        prec_demote=(0,),  # P
     )
 
 
@@ -425,6 +434,15 @@ def _ir_dual_ascent_ell() -> IRCase:
         ),
         static=dict(iters=2048),
         donate_expected=1,  # lam0
+        arg_ranges=(
+            None,
+            (0.0, 256.0, True),
+            (0.0, 1.0, False),
+            (1e-8, 1e-2, False),
+            (0.0, 1.0, False),
+            (-1e4, 1e4, False),
+        ),
+        prec_demote=(1,),  # ELL values
     )
 
 
@@ -439,6 +457,15 @@ def _ir_l2_fused() -> IRCase:
             S((C, n), f32), S((n,), f32), S((C,), f32),
             S((), f32), S((), f32), S((), f32),
         ),
+        arg_ranges=(
+            (0.0, 256.0, True),
+            (0.0, 1.0, False),
+            (0.0, 1.0, False),
+            (1e-8, 1e-2, False),
+            (1e-8, 1e-2, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(0,),  # P
     )
 
 
@@ -457,6 +484,16 @@ def _ir_l2_fused_ell() -> IRCase:
             S((C, kp), i32), S((C, kp), f32), S((n,), f32), S((C,), f32),
             S((), f32), S((), f32), S((), f32),
         ),
+        arg_ranges=(
+            None,
+            (0.0, 256.0, True),
+            (0.0, 1.0, False),
+            (0.0, 1.0, False),
+            (1e-8, 1e-2, False),
+            (1e-8, 1e-2, False),
+            (1e-8, 1e-2, False),
+        ),
+        prec_demote=(1,),  # ELL values
     )
 
 
@@ -617,7 +654,10 @@ def solve_final_primal_l2(
                             sentinel=sent,
                         )
                         idx_j = jnp.asarray(ell.idx)
-                        val_j = jnp.asarray(ell.val)
+                        val_j = demote_operator(
+                            jnp.asarray(ell.val), cfg,
+                            core="qp.l2_fused_core_ell", arg=1, log=log,
+                        )
                         with dispatch_span(
                             "qp.l2_fused_core_ell", cfg=cfg, log=log,
                             rows=int(P.shape[0]),
@@ -634,7 +674,10 @@ def solve_final_primal_l2(
                             12_288, check_every, chunk, max_chunks,
                             sentinel=sent,
                         )
-                        Pj = jnp.asarray(P, jnp.float32)
+                        Pj = demote_operator(
+                            jnp.asarray(P, jnp.float32), cfg,
+                            core="qp.l2_fused_core", arg=0, log=log,
+                        )
                         with dispatch_span(
                             "qp.l2_fused_core", cfg=cfg, log=log,
                             rows=int(P.shape[0]),
@@ -699,12 +742,18 @@ def solve_final_primal_l2(
         # agents), making the ascent step so small the spread never moved
         if ell is not None:
             idx_j = jnp.asarray(ell.idx)
-            val_j = jnp.asarray(ell.val)
+            val_j = demote_operator(
+                jnp.asarray(ell.val), cfg, core="qp.l2_dual_ascent_ell",
+                arg=1, log=log,
+            )
             sigma_sq = float(_ell_power_norm(idx_j, val_j, int(tj.shape[0]))) ** 2
         else:
             from citizensassemblies_tpu.solvers.lp_pdhg import _power_norm
 
-            Pj = jnp.asarray(P, dtype=jnp.float32)
+            Pj = demote_operator(
+                jnp.asarray(P, dtype=jnp.float32), cfg,
+                core="qp.l2_dual_ascent", arg=0, log=log,
+            )
             sigma_sq = float(_power_norm(Pj)) ** 2
         L = max(sigma_sq / 2.0, 1.0)
         with log.timer("l2_dual_ascent"):
@@ -758,7 +807,15 @@ def solve_final_primal_l2(
     floor = np.asarray(target, dtype=np.float64) - eps
     deficit = floor - alloc_l2  # > 0 where the ascent iterate undershoots
     gain = alloc_lp - alloc_l2
-    mask = deficit > 0
+    # a deficit below the f32 ulp of the allocation scale is representation
+    # noise of the float32 iterate, not an undershoot: blending on it divides
+    # two O(ulp) numbers, so β (and the returned p) would chatter with the
+    # kernel's bit-level rounding choices (e.g. the certified bf16 operand
+    # demotion) instead of staying a function of the solution itself
+    slack = float(np.finfo(np.float32).eps) * max(
+        1.0, float(np.abs(alloc_l2).max()) if alloc_l2.size else 1.0
+    )
+    mask = deficit > slack
     with np.errstate(divide="ignore", invalid="ignore"):
         ratios = np.where(mask & (gain > 0), deficit / gain, np.nan)
     finite = ratios[np.isfinite(ratios)]

@@ -43,6 +43,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from citizensassemblies_tpu.utils.config import Config
+from citizensassemblies_tpu.utils.precision import iterate_dtype
 
 #: packed-slot granularity: k_pad rounds up to a multiple of 8 (the f32
 #: sublane tile) so slot growth across CG rounds re-buckets rarely
@@ -284,6 +285,6 @@ def ell_ruiz_equilibrate(idx, val, minor: int, iters: int = 8):
         inn = jnp.where(imax > 0, jnp.sqrt(jnp.maximum(imax, 1e-10)), 1.0)
         return d_j / jn, d_i / inn
 
-    d_j0 = jnp.ones(major, dtype=val.dtype)
-    d_i0 = jnp.ones(int(minor), dtype=val.dtype)
+    d_j0 = jnp.ones(major, dtype=iterate_dtype(val.dtype))
+    d_i0 = jnp.ones(int(minor), dtype=iterate_dtype(val.dtype))
     return jax.lax.fori_loop(0, iters, body, (d_j0, d_i0))

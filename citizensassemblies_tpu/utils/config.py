@@ -263,6 +263,22 @@ class Config:
     #: (indices + values) stops beating the dense GEMV's bytes.
     sparse_fill_cutoff: float = 0.25
 
+    # --- graftgrade certified mixed precision (utils/precision.py) -------------
+    #: apply the committed ``PRECISION_PLAN.json`` bf16 operand demotion to
+    #: the PDHG/QP hot cores (dense, ELL, megakernel and batched routes):
+    #: read-only operator matrices certified ``bf16_safe`` by ``lint --prec``
+    #: ship to the device at half width, matvec accumulation stays f32, KKT
+    #: residuals and all certification/audit arithmetic stay f64-untouched,
+    #: and the sentinel → float64 host re-solve ladder backstops the runtime.
+    #: Tri-state: ``None`` = auto (accelerator backends only — on CPU the
+    #: XLA legalizer re-upcasts around every bf16 operand, so the bytes win
+    #: is waived there, see README); ``True`` forces engagement (the CPU
+    #: test route — still correct, demotion only applies when the bf16
+    #: round-trip is bit-exact, lossy operands stay f32 and are counted
+    #: ``mp_lossy_skip``); ``False`` = hard off, bit-identical to the
+    #: pre-graftgrade build (pinned by test).
+    mixed_precision: Optional[bool] = None
+
     #: route the agent-space dual LP through the mesh-sharded device PDHG
     #: (``parallel/solver.py``) whenever more than one device is visible and
     #: the portfolio has at least this many rows — the regime where the C×n

@@ -1,0 +1,152 @@
+"""Central mixed-precision policy (graftgrade runtime half).
+
+Every dtype decision the bf16 lowering can influence is routed through this
+module — graftlint R13 (``dtype-literal-hygiene``) holds the rest of the
+solver/kernel hot paths to that: 16-bit dtype literals live ONLY here, and
+operand-derived dtypes (``x.dtype`` flowing into an iterate allocation) must
+pass through :func:`iterate_dtype` so a demoted bf16 operand can never drag
+the PDHG/QP iterates, norms or KKT arithmetic below float32.
+
+The lowering itself is OPERAND demotion, not compute demotion: the committed
+``PRECISION_PLAN.json`` (ratcheted by ``citizensassemblies_tpu.lint --prec``)
+names, per registered core, which read-only operator arguments are certified
+``bf16_safe``. :func:`demote_operator` applies exactly that plan — gated by
+the tri-state ``Config.mixed_precision``, and only when the concrete array
+round-trips bf16→f32 losslessly (composition/constraint matrices here are
+small-integer valued, exact in bf16's 8-bit mantissa; a lossy operand is
+shipped at f32 and counted ``mp_lossy_skip`` instead). Matvec accumulation
+stays f32 (``preferred_element_type`` on the dot, jnp type promotion on the
+scaled ELL values), certification/audit arithmetic stays f64-untouched, and
+the PR 9 sentinel → float64 host re-solve ladder backstops the runtime.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+#: the committed, ratcheted plan artifact (repo root, next to
+#: ANALYSIS_BUDGET.json / SPMD_BUDGET.json). Regenerated deliberately via
+#: ``make update-prec-plan``; ``make check-prec`` fails on drift.
+PLAN_PATH = Path(__file__).resolve().parent.parent.parent / "PRECISION_PLAN.json"
+
+#: the ONLY 16-bit dtype literals in the hot-path packages (R13's anchor).
+#: Kept as strings so importing this module never imports jax; resolved
+#: lazily by :func:`demote_dtype`.
+_DEMOTE_NAME = "bfloat16"
+_HALF_NAMES = ("bfloat16", "float16")
+
+
+def demote_dtype():
+    """The storage dtype demoted operands use (``jnp.bfloat16``)."""
+    import jax.numpy as jnp
+
+    return jnp.bfloat16
+
+
+def is_half_dtype(dtype: Any) -> bool:
+    """True for the 16-bit floating dtypes (bfloat16/float16)."""
+    try:
+        return np.dtype(dtype).name in _HALF_NAMES
+    except TypeError:
+        return False
+
+
+def iterate_dtype(dtype: Any):
+    """Floor an operand-derived dtype at float32 for iterate/scaling use.
+
+    The hot cores derive working dtypes from their operands (``f32 =
+    val.dtype``-style); under operand demotion that inference would silently
+    make the PDHG/QP iterates, Ruiz scalings, power-iteration vectors and
+    KKT residuals bf16 — convergence-fatal at ``pdhg_tol=1e-6``, two orders
+    below bf16 resolution — or trip a ``while``/``fori`` carry dtype
+    mismatch at trace time. This is the single sanctioned mapping: 16-bit
+    in, float32 out; anything at or above float32 passes through unchanged.
+    """
+    return np.dtype("float32") if is_half_dtype(dtype) else np.dtype(dtype)
+
+
+def mixed_precision_enabled(cfg: Optional[Any]) -> bool:
+    """Resolve the tri-state ``Config.mixed_precision`` gate.
+
+    ``False`` ⇒ hard off, bit-identical to the pre-graftgrade build (pinned
+    by test). ``None`` (auto) ⇒ engage on accelerator backends only — the
+    same routing posture as ``lp_batch``/``decomp_device_pricing``: on CPU
+    the XLA legalizer re-materializes f32 converts around every bf16
+    operand, so the bytes win is a TPU/GPU phenomenon (the README records
+    the CPU-regime waiver). ``True`` forces engagement everywhere — the CPU
+    test/CI route, where demotion remains *correct* (lossless round-trip)
+    just not *profitable*.
+    """
+    mode = getattr(cfg, "mixed_precision", None) if cfg is not None else None
+    if mode is not None:
+        return bool(mode)
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
+@functools.lru_cache(maxsize=1)
+def _plan_demotable() -> dict:
+    """``{core name: tuple(demoted arg indices)}`` from the committed plan.
+
+    Missing or unreadable plan ⇒ empty mapping: with no certified plan the
+    runtime demotes NOTHING — the gate can only apply what graftgrade has
+    actually committed to ``PRECISION_PLAN.json``.
+    """
+    try:
+        data = json.loads(PLAN_PATH.read_text())
+    except (OSError, ValueError):
+        return {}
+    out = {}
+    for name, entry in data.get("cores", {}).items():
+        args = tuple(int(i) for i in entry.get("demote_args", ()))
+        if args:
+            out[name] = args
+    return out
+
+
+def plan_demote_args(core: str) -> tuple:
+    """The committed plan's certified demotable arg indices for ``core``."""
+    return _plan_demotable().get(core, ())
+
+
+def demote_operator(
+    arr: Any,
+    cfg: Optional[Any],
+    *,
+    core: str,
+    arg: Optional[int] = None,
+    log: Optional[Any] = None,
+):
+    """Demote one read-only operator array to bf16 under the committed plan.
+
+    Returns ``arr`` unchanged unless ALL of: the ``mixed_precision`` gate
+    resolves on, ``core`` has a certified ``demote_args`` entry in the
+    committed plan (containing ``arg`` when given), the array is float32,
+    and the bf16 round-trip is bit-exact. A gate-on but lossy operand is
+    kept at f32 and counted (``mp_lossy_skip``) — the contract never rides
+    on rounding luck, so engaged-vs-off stays bit-identical by construction.
+    """
+    if not mixed_precision_enabled(cfg):
+        return arr
+    certified = plan_demote_args(core)
+    if not certified or (arg is not None and int(arg) not in certified):
+        return arr
+    import jax.numpy as jnp
+
+    a = jnp.asarray(arr)
+    if a.dtype != jnp.float32:
+        return arr
+    a16 = a.astype(demote_dtype())
+    if bool(jnp.all(a16.astype(jnp.float32) == a)):
+        if log is not None:
+            log.count("mp_demoted_operands")
+        return a16
+    if log is not None:
+        log.count("mp_lossy_skip")
+    return arr

@@ -877,3 +877,59 @@ def test_r12_test_modules_exempt(tmp_path):
         "    NamedSharding(mesh, spec)\n"
     )})
     assert "R12" not in _rules(report), render_report(report)
+
+
+# --- R13: dtype literal hygiene ---------------------------------------------
+
+
+def test_r13_half_literal_flagged(tmp_path):
+    report = _lint(tmp_path, {"solvers/mod.py": (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    a = jnp.zeros(4, dtype=jnp.bfloat16)\n"  # attr literal: R13
+        "    b = np.float16(0.5)\n"                   # numpy half attr: R13
+        "    c = jnp.asarray(x, dtype='bf16')\n"      # string literal: R13
+        "    return a, b, c\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R13"]
+    assert len(viols) == 3, render_report(report)
+    assert any("bfloat16" in v.message for v in viols)
+    assert any('dtype="bf16"' in v.message for v in viols)
+
+
+def test_r13_operand_derived_dtype_flagged(tmp_path):
+    report = _lint(tmp_path, {"kernels/mod.py": (
+        "import jax.numpy as jnp\n"
+        "def f(K, P):\n"
+        "    f32 = P.dtype\n"                    # un-floored policy: R13
+        "    v = jnp.ones(3, dtype=K.dtype)\n"   # un-floored kwarg: R13
+        "    return v, f32\n"
+    )})
+    viols = [v for v in report.violations if v.rule == "R13"]
+    assert len(viols) == 2, render_report(report)
+    assert any("iterate_dtype" in v.message for v in viols)
+    assert any("P.dtype" in v.message for v in viols)
+
+
+def test_r13_floored_form_and_exemptions_clean(tmp_path):
+    half = (
+        "import jax.numpy as jnp\n"
+        "x = jnp.zeros(4, dtype=jnp.bfloat16)\n"
+    )
+    report = _lint(tmp_path, {
+        # floored: iterate_dtype(...) wraps the operand-derived dtype
+        "solvers/good.py": (
+            "import jax.numpy as jnp\n"
+            "from citizensassemblies_tpu.utils.precision import iterate_dtype\n"
+            "def f(K):\n"
+            "    return jnp.ones(3, dtype=iterate_dtype(K.dtype))\n"
+        ),
+        # exempt: test modules build half-precision fixtures on purpose
+        "tests/test_mod.py": half,
+        # exempt: R4 float64 certification module (host numpy, no demotion)
+        "solvers/lp_util.py": half,
+        # out of scope: not a solvers/ or kernels/ hot path
+        "obs/mod.py": half,
+    })
+    assert "R13" not in _rules(report), render_report(report)

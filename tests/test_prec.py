@@ -1,0 +1,484 @@
+"""graftgrade self-tests (lint/prec.py + the certified mixed-precision runtime).
+
+Mirrors test_spmd.py's contract, three layers:
+
+* the P1 error-flow walk: property-fuzzed bound soundness (the static
+  relative-error bound must dominate the measured f32-vs-f64 error on
+  operands drawn inside the declared ranges, 3 seeds x 2 shapes), interval
+  pins (cancellation chains refuse a bound), and demotion certification
+  (exact-range nominations certify, inexact and cert-core ones refuse);
+* the P2/P3 ratchet: ``--update-prec-plan`` round-trips to a clean pass,
+  and every doctored-plan class is a NAMED fail — downgraded entry, new
+  unclassified variable, bf16 demotion on a float64 certification core,
+  silent XLA re-upcast of a demoted parameter, stale fingerprint, missing
+  and orphaned entries; the compiled-HLO parsers are unit-tested on
+  synthetic text;
+* the runtime gate: ``Config.mixed_precision`` off is bit-identical to the
+  CPU default, the engaged path holds the 1e-3 L-inf contract on the
+  dual-LP and committee-QP fixtures, and ``demote_operator`` demotes only
+  losslessly (the round-trip check skips a lossy matrix and counts it).
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from citizensassemblies_tpu.lint import prec
+from citizensassemblies_tpu.lint.prec import (
+    analyze_case,
+    chain_error_bound,
+    hlo_dtype_census,
+    hlo_param_dtypes,
+    load_prec_plan,
+    prec_plan_diff,
+    prec_plan_provenance,
+    prec_report_as_json,
+    render_prec_report,
+    run_prec_checks,
+    verify_prec_core,
+)
+from citizensassemblies_tpu.lint.registry import CoreEntry, IRCase, collect
+from citizensassemblies_tpu.utils.config import default_config
+from citizensassemblies_tpu.utils.precision import (
+    PLAN_PATH,
+    _plan_demotable,
+    demote_operator,
+    is_half_dtype,
+    iterate_dtype,
+    mixed_precision_enabled,
+)
+
+S = jax.ShapeDtypeStruct
+F32 = jnp.float32
+
+
+def _entry(name: str, build) -> CoreEntry:
+    return CoreEntry(
+        name=name, path=f"tests/fixtures/{name}.py", line=1, build=build
+    )
+
+
+def _names(report):
+    return {v.name for v in report.violations}
+
+
+# --- fixture cores -----------------------------------------------------------
+
+
+def _demotable_entry() -> CoreEntry:
+    """A matvec whose matrix operand is nominated with an exact range —
+    the minimal core graftgrade must certify for demotion."""
+
+    def build():
+        # graftlint: disable=R2 -- registry fixture: built once per test, never hot
+        fn = jax.jit(lambda K, x: K @ x)
+        return IRCase(
+            fn=fn,
+            args=(S((8, 8), F32), S((8,), F32)),
+            arg_ranges=((0.0, 8.0, True), (0.0, 1.0, False)),
+            prec_demote=(0,),
+        )
+
+    return _entry("fix.demotable", build)
+
+
+def _inexact_entry() -> CoreEntry:
+    """Same shape, but the nominated operand's range is NOT exact — the
+    walk must refuse the nomination."""
+
+    def build():
+        # graftlint: disable=R2 -- registry fixture: built once per test, never hot
+        fn = jax.jit(lambda K, x: K @ x)
+        return IRCase(
+            fn=fn,
+            args=(S((8, 8), F32), S((8,), F32)),
+            arg_ranges=((0.0, 8.0, False), (0.0, 1.0, False)),
+            prec_demote=(0,),
+        )
+
+    return _entry("fix.inexact", build)
+
+
+def _cert_entry() -> CoreEntry:
+    """An allow_f64 certification core: bf16 must never reach it."""
+
+    def build():
+        def f(x):
+            # graftlint: disable=R4 -- fixture needs a strong-f64 sink on purpose
+            y = x.astype(jnp.float64)
+            return (y * y).sum()
+
+        return IRCase(
+            # graftlint: disable=R2 -- registry fixture: built once per test, never hot
+            fn=jax.jit(f), args=(S((8,), F32),), allow_f64=True
+        )
+
+    return _entry("fix.cert", build)
+
+
+# --- P1: bound soundness (property fuzz) -------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("n", [16, 64])
+def test_p1_bound_dominates_measured_error(seed, n):
+    """The static relative-error bound from the abstract walk must be an
+    upper bound on the measured f32-vs-f64 error for operands drawn inside
+    the declared ranges — on a positive dot product and a sqrt/mul chain."""
+    rng = np.random.default_rng(seed)
+    lo, hi = 0.1, 2.0
+    ranges = ((lo, hi, False), (lo, hi, False))
+    specs = (S((n,), F32), S((n,), F32))
+
+    chains = {
+        "dot": lambda a, b: jnp.dot(a, b),
+        "sqrt_mul": lambda a, b: jnp.sqrt(a) * b + a * b,
+    }
+    for label, fn in chains.items():
+        bound = chain_error_bound(fn, specs, arg_ranges=ranges)
+        assert bound is not None and bound > 0.0, label
+        a32 = rng.uniform(lo, hi, n).astype(np.float32)
+        b32 = rng.uniform(lo, hi, n).astype(np.float32)
+        got = np.asarray(fn(jnp.asarray(a32), jnp.asarray(b32)), np.float64)
+        ref = _f64_ref(label, a32, b32)
+        measured = float(np.max(np.abs(got - ref) / np.maximum(np.abs(ref), 1e-300)))
+        assert measured <= bound, (
+            f"{label} n={n} seed={seed}: measured {measured:.3e} exceeds "
+            f"static bound {bound:.3e}"
+        )
+
+
+def _f64_ref(label: str, a32: np.ndarray, b32: np.ndarray) -> np.ndarray:
+    a, b = a32.astype(np.float64), b32.astype(np.float64)
+    if label == "dot":
+        return np.atleast_1d(a @ b)
+    return np.sqrt(a) * b + a * b
+
+
+def test_p1_cancellation_refuses_a_bound():
+    # overlapping-range subtraction can cancel: the walk must say "unbounded"
+    bound = chain_error_bound(
+        lambda a, b: a - b,
+        (S((8,), F32), S((8,), F32)),
+        arg_ranges=((0.0, 1.0, False), (0.0, 1.0, False)),
+    )
+    assert bound is None
+
+
+def test_p1_certifies_exact_and_refuses_inexact():
+    rep = analyze_case(_demotable_entry().build())
+    assert rep.certified_demote == [0]
+    assert rep.arg_classes[0] == "bf16_safe"
+    # the dot output itself is accumulation-pinned by rule
+    assert rep.classes["f32_required"] >= 1 and rep.classes["bf16_safe"] == 0
+    rep2 = analyze_case(_inexact_entry().build())
+    assert rep2.certified_demote == []
+    # the refused nomination is a named P1 FAIL on the full check
+    report = verify_prec_core(_inexact_entry(), None, update_plan=True)
+    assert "uncertified-demotion" in {v.name for v in report.violations}
+
+
+def test_p1_cert_core_never_certifies():
+    rep = analyze_case(_cert_entry().build())
+    assert rep.certified_demote == []
+    assert rep.classes["f64_cert"] >= 1
+
+
+# --- P3 parsers (synthetic HLO) ----------------------------------------------
+
+
+def test_hlo_parsers_on_synthetic_text():
+    text = (
+        "ENTRY %main (p0: bf16[8,8], p1: f32[8]) -> f32[8] {\n"
+        "  %p0 = bf16[8,8]{1,0} parameter(0)\n"
+        "  %p1 = f32[8]{0} parameter(1)\n"
+        "  %c = f32[8,8]{1,0} convert(bf16[8,8]{1,0} %p0)\n"
+        "  ROOT %dot = f32[8]{0} dot(f32[8,8]{1,0} %c, f32[8]{0} %p1)\n"
+        "}\n"
+    )
+    assert hlo_param_dtypes(text) == {0: "bf16", 1: "f32"}
+    census = hlo_dtype_census(text)
+    assert census["bf16"] == 3 and census["f64"] == 0 and census["f32"] >= 4
+
+
+# --- P2/P3: the plan ratchet and its named FAILs -----------------------------
+
+
+def _fresh_plan(tmp_path, entries):
+    plan = tmp_path / "PRECISION_PLAN.json"
+    report = run_prec_checks(entries=entries, plan_path=plan, update_plan=True)
+    assert report.ok, render_prec_report(report)
+    return plan
+
+
+def test_update_plan_roundtrip(tmp_path):
+    entries = [_demotable_entry(), _cert_entry()]
+    plan = _fresh_plan(tmp_path, entries)
+    report = run_prec_checks(entries=entries, plan_path=plan)
+    assert report.ok, render_prec_report(report)
+    # the demotable core really lowered its matrix at bf16
+    rep = {r.name: r for r in report.cores}["fix.demotable"]
+    assert rep.applied_demote == [0]
+    assert rep.census["bf16"] >= 1
+    assert rep.cert_isolated is True
+    assert rep.traffic["reduction_pct"] > 25.0
+
+
+def test_missing_entry_is_named_fail(tmp_path):
+    plan = _fresh_plan(tmp_path, [_demotable_entry()])
+    data = json.loads(plan.read_text())
+    del data["cores"]["fix.demotable"]
+    plan.write_text(json.dumps(data))
+    report = run_prec_checks(entries=[_demotable_entry()], plan_path=plan)
+    assert _names(report) == {"missing-plan-entry"}
+
+
+def test_downgraded_entry_is_named_fail(tmp_path):
+    # doctor 1: the plan demotes an argument the walk refuses
+    plan = _fresh_plan(tmp_path, [_demotable_entry()])
+    data = json.loads(plan.read_text())
+    data["cores"]["fix.demotable"]["demote_args"] = [0, 1]
+    plan.write_text(json.dumps(data))
+    report = run_prec_checks(entries=[_demotable_entry()], plan_path=plan)
+    assert "plan-downgrade" in _names(report)
+
+    # doctor 2: the plan claims more bf16_safe intermediates than certified
+    plan = _fresh_plan(tmp_path, [_demotable_entry()])
+    data = json.loads(plan.read_text())
+    data["cores"]["fix.demotable"]["classes"]["bf16_safe"] += 3
+    data["cores"]["fix.demotable"]["classes"]["f32_required"] -= 3
+    plan.write_text(json.dumps(data))
+    report = run_prec_checks(entries=[_demotable_entry()], plan_path=plan)
+    assert "plan-downgrade" in _names(report)
+
+
+def test_unclassified_var_is_named_fail(tmp_path):
+    plan = _fresh_plan(tmp_path, [_demotable_entry()])
+    data = json.loads(plan.read_text())
+    data["cores"]["fix.demotable"]["n_vars"] += 1  # a var with no class
+    plan.write_text(json.dumps(data))
+    report = run_prec_checks(entries=[_demotable_entry()], plan_path=plan)
+    assert "unclassified-var" in _names(report)
+
+
+def test_bf16_into_cert_sink_is_named_fail(tmp_path):
+    plan = _fresh_plan(tmp_path, [_cert_entry()])
+    data = json.loads(plan.read_text())
+    data["cores"]["fix.cert"]["demote_args"] = [0]
+    plan.write_text(json.dumps(data))
+    report = run_prec_checks(entries=[_cert_entry()], plan_path=plan)
+    assert "bf16-into-cert-sink" in _names(report)
+
+
+def test_stale_fingerprint_and_orphan_entry_are_named_fails(tmp_path):
+    plan = _fresh_plan(tmp_path, [_demotable_entry()])
+    data = json.loads(plan.read_text())
+    data["cores"]["fix.demotable"]["jaxpr_sha"] = "deadbeef0000"
+    data["cores"]["fix.gone"] = dict(data["cores"]["fix.demotable"])
+    plan.write_text(json.dumps(data))
+    report = run_prec_checks(entries=[_demotable_entry()], plan_path=plan)
+    assert "stale-plan-entry" in _names(report)
+    orphans = [
+        v for v in report.violations
+        if v.name == "stale-plan-entry" and "fix.gone" in v.message
+    ]
+    assert orphans, render_prec_report(report)
+
+
+def test_silent_upcast_is_named_fail(tmp_path, monkeypatch):
+    plan = _fresh_plan(tmp_path, [_demotable_entry()])
+    # simulate XLA re-upcasting the demoted edge: the parameter census
+    # reports f32 where the plan demoted to bf16
+    monkeypatch.setattr(
+        prec, "hlo_param_dtypes", lambda text: {0: "f32", 1: "f32"}
+    )
+    report = run_prec_checks(entries=[_demotable_entry()], plan_path=plan)
+    assert "silent-upcast" in _names(report)
+
+
+def test_update_plan_drops_p2_but_keeps_p1(tmp_path):
+    plan = tmp_path / "PRECISION_PLAN.json"
+    report = run_prec_checks(
+        entries=[_inexact_entry()], plan_path=plan, update_plan=True
+    )
+    names = _names(report)
+    assert "uncertified-demotion" in names  # P1 survives the ratchet move
+    assert "missing-plan-entry" not in names  # P2 is the new plan itself
+
+
+# --- the shared envelope and the diff artifact -------------------------------
+
+
+def test_envelope_and_diff(tmp_path):
+    entries = [_demotable_entry()]
+    plan = _fresh_plan(tmp_path, entries)
+    report = run_prec_checks(entries=entries, plan_path=plan)
+    env = prec_report_as_json(report)
+    assert env["schema_version"] == 1 and env["pass"] == "prec" and env["ok"]
+    core = env["cores"][0]
+    # S3's cert_isolated verdict folds into the prec envelope (satellite:
+    # the scope-level and compiled-truth views cannot drift apart)
+    assert core["cert_isolated"] is True
+    assert core["demote_args"] == [0]
+    diff = prec_plan_diff(report)
+    assert diff["cores_over_25pct"] >= 1
+    assert "waiver" in diff and "XLA:CPU" in diff["waiver"]
+    assert diff["traffic"]["fix.demotable"]["demote_args"] == [0]
+    prov = prec_plan_provenance(plan)
+    assert prov["cores"] == 1 and prov["demoted"] == 1 and "sha256" in prov
+
+
+# --- the committed plan vs the real registry ---------------------------------
+
+
+def test_real_cores_pass_against_committed_plan(tmp_path):
+    """Two flagship cores re-certify against their COMMITTED plan entries
+    (the full 24-core sweep is `make check-prec`; this is the tier-1 canary
+    that the committed artifact matches the shipped solvers)."""
+    assert PLAN_PATH.exists(), "run make update-prec-plan and commit"
+    committed = load_prec_plan(PLAN_PATH)
+    names = ("lp_pdhg.pdhg_core", "qp.l2_dual_ascent")
+    entries = [e for e in collect() if e.name in names]
+    assert len(entries) == 2
+    trimmed = tmp_path / "PRECISION_PLAN.json"
+    trimmed.write_text(
+        json.dumps({"cores": {n: committed[n] for n in names}})
+    )
+    report = run_prec_checks(entries=entries, plan_path=trimmed)
+    assert report.ok, render_prec_report(report)
+    for rep in report.cores:
+        assert rep.applied_demote, rep.name
+        assert rep.census["bf16"] >= 1
+        assert rep.cert_isolated is True
+        assert rep.traffic["reduction_pct"] >= 25.0
+
+
+def test_committed_plan_covers_every_registered_core():
+    committed = load_prec_plan(PLAN_PATH)
+    registered = {e.name for e in collect()}
+    assert registered == set(committed), (
+        "PRECISION_PLAN.json out of sync with the registry — "
+        "run make update-prec-plan"
+    )
+    for name, entry in committed.items():
+        assert sum(entry["classes"].values()) == entry["n_vars"], name
+
+
+# --- the runtime gate (utils/precision.py) -----------------------------------
+
+
+class _CountLog:
+    def __init__(self):
+        self.counts = {}
+
+    def count(self, name, inc=1):
+        self.counts[name] = self.counts.get(name, 0) + inc
+
+
+def test_iterate_dtype_floors_half_at_f32():
+    assert iterate_dtype(jnp.bfloat16) == np.dtype("float32")
+    assert iterate_dtype(np.float16) == np.dtype("float32")
+    assert iterate_dtype(np.float32) == np.dtype("float32")
+    assert iterate_dtype(np.float64) == np.dtype("float64")
+    assert is_half_dtype(jnp.bfloat16) and not is_half_dtype(np.float32)
+
+
+def test_gate_semantics_tri_state():
+    cfg = default_config()
+    assert cfg.mixed_precision is None
+    # auto = accelerator-only: off on the CPU test backend
+    assert mixed_precision_enabled(cfg) is False
+    assert mixed_precision_enabled(cfg.replace(mixed_precision=True)) is True
+    assert mixed_precision_enabled(cfg.replace(mixed_precision=False)) is False
+
+
+def test_demote_operator_lossless_only():
+    _plan_demotable.cache_clear()
+    cfg_on = default_config().replace(mixed_precision=True)
+    cfg_off = default_config().replace(mixed_precision=False)
+    exact = jnp.asarray(np.arange(64, dtype=np.float32).reshape(8, 8))
+    lossy = exact + np.float32(0.1)
+
+    log = _CountLog()
+    out = demote_operator(exact, cfg_on, core="lp_pdhg.pdhg_core", arg=1, log=log)
+    assert out.dtype == jnp.bfloat16
+    assert np.array_equal(np.asarray(out, np.float32), np.asarray(exact))
+    assert log.counts == {"mp_demoted_operands": 1}
+
+    log = _CountLog()
+    out = demote_operator(lossy, cfg_on, core="lp_pdhg.pdhg_core", arg=1, log=log)
+    assert out.dtype == jnp.float32  # round-trip failed: stays f32
+    assert log.counts == {"mp_lossy_skip": 1}
+
+    # gate off / uncertified arg / uncertified core: untouched, uncounted
+    log = _CountLog()
+    assert demote_operator(exact, cfg_off, core="lp_pdhg.pdhg_core", arg=1, log=log) is exact
+    assert demote_operator(exact, cfg_on, core="lp_pdhg.pdhg_core", arg=0, log=log) is exact
+    assert demote_operator(exact, cfg_on, core="no.such_core", arg=1, log=log) is exact
+    assert log.counts == {}
+
+
+def _dual_lp_fixture(n=20, rows=30, seed=3):
+    rng = np.random.default_rng(seed)
+    P01 = (rng.random((rows, n)) < 0.4).astype(np.float64)
+    P01[:n, :n] += np.eye(n)
+    P01 = np.clip(P01, 0.0, 1.0)
+    c = np.concatenate([np.zeros(n), [1.0]])
+    G = np.hstack([P01, -np.ones((rows, 1))])
+    h = np.zeros(rows)
+    A = np.concatenate([np.ones(n), [0.0]])[None, :]
+    b = np.array([1.0])
+    return c, G, h, A, b
+
+
+def test_mixed_precision_dual_lp_contract():
+    """The flagship dual-LP fixture through solve_lp: gate-off is
+    bit-identical to the CPU default, and the engaged plan holds the 1e-3
+    L-inf contract (constraint matrices are 0/1-exact, so the demotion is
+    lossless by the round-trip rule)."""
+    from citizensassemblies_tpu.solvers.lp_pdhg import solve_lp
+
+    _plan_demotable.cache_clear()
+    c, G, h, A, b = _dual_lp_fixture()
+    sol_def = solve_lp(c, G, h, A, b, cfg=default_config())
+    sol_off = solve_lp(c, G, h, A, b, cfg=default_config().replace(mixed_precision=False))
+    sol_on = solve_lp(c, G, h, A, b, cfg=default_config().replace(mixed_precision=True))
+
+    # off == default on CPU: the gate is pinned, bit-identical
+    assert np.array_equal(sol_off.x, sol_def.x)
+    assert sol_off.objective == sol_def.objective and sol_off.kkt == sol_def.kkt
+
+    # engaged: converged, and within the exactness contract of the off path
+    assert sol_on.ok and sol_off.ok
+    assert float(np.max(np.abs(sol_on.x - sol_off.x))) <= 1e-3
+    assert abs(sol_on.objective - sol_off.objective) <= 1e-3
+
+
+def test_mixed_precision_committee_qp_contract():
+    """The committee-QP (household polish) fixture through
+    solve_final_primal_l2: engaged vs off within 1e-3 on the probability
+    vector and the epsilon floor."""
+    from citizensassemblies_tpu.solvers.qp import solve_final_primal_l2
+
+    _plan_demotable.cache_clear()
+    rng = np.random.default_rng(11)
+    C, n = 60, 16
+    P = (rng.random((C, n)) < 0.35).astype(bool)
+    P[:n, :n] |= np.eye(n, dtype=bool)
+    donor = np.zeros(C)
+    donor[:20] = rng.random(20)
+    donor /= donor.sum()
+    t = np.clip(P[:20].T.astype(np.float64) @ donor[:20], 0.0, 1.0)
+
+    p_off, e_off = solve_final_primal_l2(
+        P, t, iters=4000, cfg=default_config().replace(mixed_precision=False)
+    )
+    p_on, e_on = solve_final_primal_l2(
+        P, t, iters=4000, cfg=default_config().replace(mixed_precision=True)
+    )
+    assert float(np.max(np.abs(p_on - p_off))) <= 1e-3
+    assert abs(e_on - e_off) <= 1e-3
