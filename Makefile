@@ -81,3 +81,16 @@ coldboot-smoke:
 
 coldboot:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --coldboot
+
+# graftfleet (service/fleet.py): N-process SLO-driven serving fleet under an
+# open-loop seeded Poisson load — tenant-affine rendezvous placement, mesh-
+# spanning fused batcher dispatches, zero steady-state reshards, allocations
+# bit-identical to single-process serial references, and the shed/degrade
+# drill (typed ShedRejection + ladder descent + recovery re-arm). The full
+# run drives 10^4 mixed requests through >= 4 processes and writes the
+# committed BENCH_fleet_r*.json trend row.
+fleet-smoke:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --fleet --smoke
+
+fleet:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --fleet

@@ -1892,20 +1892,26 @@ def serve_bench(smoke_mode: bool = False) -> int:
         failures.append("identical re-submission was not served from the tenant memo")
 
     # --- grafttrace artifacts: merged per-request trace + Prometheus dump --
+    from citizensassemblies_tpu.dist.runtime import scoped_artifact_path
     from citizensassemblies_tpu.obs import validate_chrome_trace
 
     art_dir = _artifacts_dir()
-    serve_trace_path = os.environ.get(
-        "BENCH_SERVE_TRACE_PATH", os.path.join(art_dir, "trace_serve_smoke.json")
-    ) if smoke_mode else os.path.join(art_dir, "trace_serve.json")
+    # fleet-safe artifact paths: suffixed by process index on multi-process
+    # runs so concurrent serving children never clobber each other's
+    # evidence (a no-op on single-process runs — names stay stable)
+    serve_trace_path = scoped_artifact_path(
+        os.environ.get(
+            "BENCH_SERVE_TRACE_PATH", os.path.join(art_dir, "trace_serve_smoke.json")
+        ) if smoke_mode else os.path.join(art_dir, "trace_serve.json")
+    )
     serve_doc = svc.export_traces(path=serve_trace_path)
     serve_schema_problems = validate_chrome_trace(serve_doc)
     if serve_schema_problems:
         failures.append(f"serve trace schema invalid: {serve_schema_problems[:3]}")
     prom_text = svc.metrics_text()
-    serve_metrics_path = os.path.join(
+    serve_metrics_path = scoped_artifact_path(os.path.join(
         art_dir, "metrics_serve_smoke.prom" if smoke_mode else "metrics_serve.prom"
-    )
+    ))
     try:
         with open(serve_metrics_path, "w", encoding="utf-8") as fh:
             fh.write(prom_text)
@@ -1949,9 +1955,9 @@ def serve_bench(smoke_mode: bool = False) -> int:
             failures.append(
                 f"committed SLO spec violated: {slo_report['breaches']}"
             )
-        slo_path = os.path.join(
+        slo_path = scoped_artifact_path(os.path.join(
             art_dir, "SLO_report_smoke.json" if smoke_mode else "SLO_report.json"
-        )
+        ))
         try:
             with open(slo_path, "w", encoding="utf-8") as fh:
                 json.dump(
@@ -3135,6 +3141,488 @@ def coldboot_bench(smoke_mode: bool) -> int:
     return 1 if failures else 0
 
 
+def _fleet_rate_hz(smoke_mode: bool) -> float:
+    """The fleet offered rate: ``BENCH_FLEET_RATE`` env override, else a
+    small smoke literal, else the ``Config.fleet_offered_rate_hz`` knob —
+    the single source of the full-run default (README table, R6)."""
+    env = os.environ.get("BENCH_FLEET_RATE", "")
+    if env:
+        return float(env)
+    if smoke_mode:
+        return 30.0
+    from citizensassemblies_tpu.utils.config import default_config
+
+    return float(default_config().fleet_offered_rate_hz)
+
+
+def fleet_bench_child(idx: int, smoke_mode: bool) -> int:
+    """``bench.py --fleet`` (child, one serving process of the fleet).
+
+    Every child deterministically rebuilds the IDENTICAL global plan —
+    seeded Poisson arrivals at the fleet offered rate, seeded tenant draws,
+    rendezvous tenant→process placement — and serves only its own share,
+    so the fleet needs no IPC beyond process launch. The child runs serial
+    references first (which also warms every executable its shapes need),
+    drives its share open-loop through a :class:`FleetProcess`, checks
+    every served allocation against its serial reference, and — child 0
+    only — runs the SLO shed/degrade drill (induced overload → breach
+    events streamed → typed ShedRejection shedding + ladder descent →
+    recovery re-arms). Prints ONE JSON report line for the parent.
+    """
+    _dist_scope_caches()
+
+    import jax
+    import numpy as np
+
+    from citizensassemblies_tpu.core.generator import random_instance
+    from citizensassemblies_tpu.core.instance import featurize
+    from citizensassemblies_tpu.dist import runtime as dist_runtime
+    from citizensassemblies_tpu.models.leximin import find_distribution_leximin
+    from citizensassemblies_tpu.service import (
+        SelectionRequest,
+        SelectionService,
+    )
+    from citizensassemblies_tpu.service.fleet import (
+        FleetProcess,
+        plan_from_config,
+    )
+    from citizensassemblies_tpu.utils.config import default_config
+
+    t_start = time.time()
+    failures: list = []
+    nproc = dist_runtime.fleet_process_count()
+    seed = int(os.environ.get("BENCH_FLEET_SEED", "20"))
+    rate = _fleet_rate_hz(smoke_mode)
+    n_requests = int(
+        os.environ.get("BENCH_FLEET_REQUESTS", "60" if smoke_mode else "10000")
+    )
+    # the full run's p99 target is sized to the container: 10^4 open-loop
+    # requests at the full offered rate on an N-way-oversubscribed CPU host
+    # queue for minutes BY DESIGN (open loop makes queueing visible instead
+    # of self-throttling) — the objective gates completion health, not a
+    # fabricated hardware latency
+    slo_spec = (
+        _SERVE_SLO_SPEC
+        if smoke_mode
+        else os.environ.get("BENCH_FLEET_SLO", "latency_p99:600s,error_rate:0.01")
+    )
+    cfg = default_config().replace(
+        lp_batch=True, serve_batch_window_ms=8.0, serve_admission_cap=8,
+        # open loop: arrivals never wait for completions, so the queue must
+        # absorb the whole backlog — admission back-pressure off for the
+        # measurement run (the shed drill exercises load rejection instead)
+        serve_queue_depth=max(n_requests, 64),
+        obs_slo_spec=slo_spec,
+    )
+
+    # --- the global plan (identical in every child) ------------------------
+    tenants, plan = plan_from_config(
+        cfg, n_requests, seed=seed, n_processes=nproc, rate_hz=rate
+    )
+    mine = [a for a in plan if a.owner == idx]
+
+    # deterministic mixed workload: each tenant owns a small pool of unique
+    # mixed-size instances; plan slot i reuses pool[i % uniq], so identical
+    # re-submissions ride the tenant memo — the unique/repeat split of a
+    # serving workload, recorded honestly on the report
+    uniq = 3 if smoke_mode else 6
+    tenant_ix = {t: i for i, t in enumerate(tenants)}
+    pools: dict = {}
+
+    def spec_for(a):
+        ti = tenant_ix[a.tenant]
+        pool = pools.get(a.tenant)
+        if pool is None:
+            pool = [
+                random_instance(
+                    n=24 + 8 * ((ti + j) % 3), k=4 + ((ti + j) % 4),
+                    n_categories=2, seed=(ti * 31 + j) % 97,
+                )
+                for j in range(uniq)
+            ]
+            pools[a.tenant] = pool
+        j = a.index % uniq
+        return pool[j], (a.tenant, j)
+
+    items = []
+    needed: dict = {}
+    key_of: dict = {}
+    for a in mine:
+        inst, key = spec_for(a)
+        items.append((a, SelectionRequest(instance=inst, tenant=a.tenant)))
+        needed.setdefault(key, inst)
+        key_of[a.index] = key
+
+    # serial references FIRST: the single-process bit-identity baseline,
+    # and the warm-up that makes the drive measure steady-state serving
+    refs: dict = {}
+    t_serial0 = time.time()
+    for key in sorted(needed):
+        d, s = featurize(needed[key])
+        refs[key] = np.asarray(
+            find_distribution_leximin(d, s, cfg=cfg).allocation
+        )
+    serial_s = time.time() - t_serial0
+
+    # --- the open-loop drive -----------------------------------------------
+    worst = {"linf": 0.0, "bit_identical": True}
+
+    def check(a, res):
+        ref = refs.get(key_of[a.index])
+        alloc = np.asarray(res.allocation)
+        if ref is None or alloc.shape != ref.shape:
+            worst["linf"] = max(worst["linf"], float("inf"))
+            return
+        if alloc.size:
+            worst["linf"] = max(
+                worst["linf"], float(np.max(np.abs(alloc - ref)))
+            )
+        if not np.array_equal(alloc, ref):
+            worst["bit_identical"] = False
+
+    fp = FleetProcess(idx, nproc, cfg)
+    t_drive0 = time.time()
+    rollup = fp.drive(
+        items, timeout_s=900.0 if smoke_mode else 3000.0, on_result=check
+    )
+    drive_s = time.time() - t_drive0
+    prom_text = fp.service.metrics_text()
+    slo_report = fp.service.slo.evaluate() if fp.service.slo else None
+    fp.shutdown()
+
+    # --- child gates --------------------------------------------------------
+    b = rollup["batcher"]
+    if b.get("dist_reshards", 0):
+        failures.append(
+            f"p{idx}: {b['dist_reshards']} steady-state reshard(s) "
+            "(gauge must hold at 0)"
+        )
+    if len(jax.devices()) > 1 and b.get("mesh_dispatches", 0) < 1:
+        failures.append(f"p{idx}: no batcher dispatch spanned the mesh")
+    if rollup["failed"] or rollup["shed"] or rollup["admission_rejected"]:
+        failures.append(
+            f"p{idx}: {rollup['failed']} failed / {rollup['shed']} shed / "
+            f"{rollup['admission_rejected']} rejected in the measurement run"
+        )
+    if rollup["completed"] != rollup["offered"]:
+        failures.append(
+            f"p{idx}: completed {rollup['completed']} != offered "
+            f"{rollup['offered']}"
+        )
+    if worst["linf"] > 1e-3:
+        failures.append(
+            f"p{idx}: served allocation deviates {worst['linf']:.2e} > 1e-3 "
+            "vs serial reference"
+        )
+    if slo_report is not None and not slo_report["slo_ok"]:
+        failures.append(f"p{idx}: SLO report red: {slo_report['breaches']}")
+
+    # --- per-process artifacts, suffixed by process index (the satellite
+    # multi-process contract: concurrent children never clobber evidence)
+    art = _artifacts_dir()
+    suffix = "_smoke" if smoke_mode else ""
+    prom_path = dist_runtime.scoped_artifact_path(
+        os.path.join(art, f"metrics_fleet{suffix}.prom")
+    )
+    slo_path = dist_runtime.scoped_artifact_path(
+        os.path.join(art, f"SLO_fleet{suffix}.json")
+    )
+    try:
+        with open(prom_path, "w", encoding="utf-8") as fh:
+            fh.write(prom_text)
+        with open(slo_path, "w", encoding="utf-8") as fh:
+            json.dump({"spec": slo_spec, "report": slo_report}, fh, indent=1)
+            fh.write("\n")
+    except OSError:
+        pass
+
+    # --- the SLO shed/degrade drill (child 0 only: one drill per fleet) ----
+    drill_block = None
+    if idx == 0:
+        # a small dedicated instance — pools only hold this child's OWNED
+        # tenants, and tenant0 may belong to a sibling process
+        drill_inst = random_instance(n=24, k=4, n_categories=2, seed=0)
+        drill_block = _fleet_drill(cfg, drill_inst, failures)
+
+    report = {
+        "fleet_child": idx,
+        "processes": nproc,
+        "visible_devices": len(jax.devices()),
+        "seconds": round(time.time() - t_start, 2),
+        "serial_refs_s": round(serial_s, 2),
+        "drive_s": round(drive_s, 2),
+        "unique_specs": len(needed),
+        "worst_alloc_linf": (
+            worst["linf"] if np.isfinite(worst["linf"]) else "shape-mismatch"
+        ),
+        "bit_identical": worst["bit_identical"],
+        "rollup": rollup,
+        "slo_ok": None if slo_report is None else slo_report["slo_ok"],
+        "artifacts": [os.path.basename(prom_path), os.path.basename(slo_path)],
+        "drill": drill_block,
+        "failures": failures,
+    }
+    print(json.dumps(report))
+    return 1 if failures else 0
+
+
+def _fleet_drill(cfg, inst, failures: list):
+    """Induced overload → breach stream → shedding + ladder descent →
+    recovery re-arm, on a dedicated service. ``queue_stall:1.0`` stalls
+    every request 0.25 s pre-execution against a 50 ms p99 objective, so
+    the fast window must breach; ``serve_shed=True`` closes the loop."""
+    from citizensassemblies_tpu.service import (
+        SelectionRequest,
+        SelectionService,
+    )
+
+    drill_cfg = cfg.replace(
+        fault_sites="queue_stall:1.0", fault_seed=7,
+        obs_slo_spec="latency_p99:50ms,error_rate:0.5",
+        serve_shed=True, serve_shed_window_s=1.0,
+        serve_shed_burn=2.0, serve_shed_recover=0.5,
+        serve_batch_window_ms=0.0, serve_queue_depth=64,
+        obs_metrics_interval_s=0.0,
+    )
+    drill = SelectionService(drill_cfg)
+    block = {}
+    try:
+        # phase 1 — overload: a stalled burst must stream breach events
+        chans = [
+            drill.submit(SelectionRequest(instance=inst, tenant="drill"))
+            for _ in range(6)
+        ]
+        breach_events = 0
+        for ch in chans:
+            try:
+                ch.result(timeout=600)
+            except RuntimeError:
+                pass  # late burst members may already be shed — counted below
+            breach_events += sum(
+                1 for kind, _p in ch.events(timeout=1) if kind == "slo"
+            )
+        # phase 2 — shedding: new submissions get the typed rejection
+        shed_payloads = []
+        for _ in range(4):
+            ch = drill.submit(SelectionRequest(instance=inst, tenant="drill"))
+            for kind, payload in ch.events(timeout=10):
+                if kind == "error" and isinstance(payload, dict):
+                    shed_payloads.append(payload)
+        sheds = [p for p in shed_payloads if p.get("kind") == "ShedRejection"]
+        stamp_hot = drill.load_policy.stamp()
+        # phase 3 — recovery: the fast window empties, the next clean
+        # submission re-arms the policy and is served normally
+        time.sleep(1.2 * drill_cfg.serve_shed_window_s)
+        clean = drill_cfg.replace(fault_sites="")
+        res = drill.run(
+            SelectionRequest(instance=inst, tenant="drill", cfg=clean),
+            timeout=600,
+        )
+        stamp_rearmed = drill.load_policy.stamp()
+        block = {
+            "breach_events": breach_events,
+            "shed": len(sheds),
+            "audit_stub_ok": all(
+                {"tenant", "request_id", "worst_burn", "rung"}
+                <= set(p.get("audit", {}))
+                for p in sheds
+            ),
+            "rung_hot": stamp_hot["rung"],
+            "shed_total": stamp_hot["shed_total"],
+            "rearm_total": stamp_rearmed["rearm_total"],
+            "recovered_request_ok": bool(res.allocation is not None),
+        }
+        if breach_events < 1:
+            failures.append("drill: no ('slo', …) breach event streamed")
+        if len(sheds) < 1:
+            failures.append("drill: overload shed no submission")
+        if not block["audit_stub_ok"]:
+            failures.append("drill: a ShedRejection carried no audit stub")
+        if stamp_hot["rung"] < 1:
+            failures.append("drill: ladder never descended under overload")
+        if stamp_rearmed["rearm_total"] < 1:
+            failures.append("drill: recovery never re-armed the policy")
+    finally:
+        drill.shutdown()
+    return block
+
+
+def fleet_bench(smoke_mode: bool) -> int:
+    """``bench.py --fleet`` (parent): the graftfleet serving harness.
+
+    Forks N serving children (independent OS processes, 2 forced virtual
+    devices each — the per-process mesh the batcher's sharded merge spans),
+    exports the ``CITIZENS_FLEET_*`` contract, and aggregates their rollups
+    into the fleet row. Gates: every process served its share, the summed
+    PR 11 reshard gauge held at ZERO, ≥1 mesh-spanning and ≥1 cross-request
+    fused dispatch occurred, worst served-vs-serial allocation L∞ ≤ 1e-3,
+    the SLO reports are green, and child 0's shed/degrade drill passed.
+    Writes ``artifacts/BENCH_fleet_smoke.json`` (smoke) or the next
+    ``BENCH_fleet_rNN.json`` round (``BENCH_FLEET_PATH`` overrides) with
+    ``detail`` rows for the obs/trend.py BENCH_fleet family loader.
+    """
+    import subprocess
+
+    n_proc = int(
+        os.environ.get("BENCH_FLEET_PROCESSES", "2" if smoke_mode else "4")
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    env["CITIZENS_FLEET_PROCESSES"] = str(n_proc)
+    env.setdefault("BENCH_FLEET_SEED", "20")
+
+    t0 = time.time()
+    cmd = [sys.executable, os.path.abspath(__file__), "--fleet"]
+    if smoke_mode:
+        cmd.append("--smoke")
+    procs = []
+    for i in range(n_proc):
+        cenv = dict(env)
+        cenv["BENCH_FLEET_CHILD"] = str(i)
+        cenv["CITIZENS_FLEET_INDEX"] = str(i)
+        procs.append(
+            subprocess.Popen(
+                cmd, env=cenv, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+        )
+    failures: list = []
+    children = []
+    for i, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=1200 if smoke_mode else 5400)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            failures.append(f"child {i} timed out")
+        tail = "\n".join((out + "\n" + err).splitlines()[-25:])
+        for marker in ("cpu_aot_loader", "machine mismatch"):
+            if marker in tail:
+                failures.append(f"child {i}: '{marker}' spam in the run tail")
+        report = None
+        for line in reversed(out.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    report = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if report is None:
+            sys.stdout.write(out)
+            sys.stderr.write(err)
+            failures.append(f"child {i}: no report line")
+            continue
+        if proc.returncode != 0:
+            failures.append(f"child {i} exited {proc.returncode}")
+        failures.extend(report.get("failures", []))
+        children.append(report)
+    wall_s = time.time() - t0
+
+    from citizensassemblies_tpu.service.fleet import fleet_aggregate
+
+    agg = fleet_aggregate([c["rollup"] for c in children])
+    drill = next((c.get("drill") for c in children if c.get("drill")), None)
+    worst_linf = max(
+        (
+            c["worst_alloc_linf"]
+            for c in children
+            if isinstance(c.get("worst_alloc_linf"), (int, float))
+        ),
+        default=float("inf") if children else 0.0,
+    )
+
+    # --- fleet gates --------------------------------------------------------
+    if len(children) != n_proc:
+        failures.append(f"only {len(children)}/{n_proc} children reported")
+    if any(c["rollup"]["completed"] == 0 for c in children):
+        failures.append("a fleet process served zero requests")
+    if agg["steady_state_reshards"] != 0:
+        failures.append(
+            f"fleet reshard gauge {agg['steady_state_reshards']} != 0"
+        )
+    if agg["batcher"]["mesh_dispatches"] < 1:
+        failures.append("no fused batcher dispatch spanned a mesh")
+    if agg["batcher"]["fused_dispatches"] < 1:
+        failures.append("no dispatch fused fleets from >=2 requests")
+    if worst_linf > 1e-3:
+        failures.append(f"fleet worst allocation L-inf {worst_linf:.2e} > 1e-3")
+    if drill is None:
+        failures.append("no child ran the shed/degrade drill")
+
+    # round number: 1 past the newest committed BENCH_fleet_r*.json
+    import re
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(m.group(1))
+        for f in os.listdir(repo_root)
+        if (m := re.match(r"BENCH_fleet_r(\d+)\.json$", f))
+    ]
+    rnd = (max(rounds) + 1) if rounds else 20
+
+    doc = {
+        "schema_version": 1,
+        "fleet_ok": not failures,
+        "round": rnd,
+        "smoke": bool(smoke_mode),
+        "backend": "cpu",
+        "processes": n_proc,
+        "offered_rate_hz": _fleet_rate_hz(smoke_mode),
+        "requests": agg["offered"],
+        "seconds": round(wall_s, 2),
+        "aggregate": agg,
+        "worst_alloc_linf": (
+            round(worst_linf, 9) if worst_linf != float("inf") else None
+        ),
+        "drill": drill,
+        "per_process": [
+            {
+                **{k: v for k, v in c.items() if k not in ("rollup", "drill")},
+                "rollup": {
+                    k: v for k, v in c["rollup"].items() if k != "sojourns_s"
+                },
+            }
+            for c in children
+        ],
+        "detail": {
+            "fleet_open_loop": {
+                "seconds": round(
+                    max((c["drive_s"] for c in children), default=0.0), 3
+                ),
+                "sustained_req_per_s": agg["sustained_req_per_s"],
+                "p50_sojourn_s": agg["p50_sojourn_s"],
+                "p99_sojourn_s": agg["p99_sojourn_s"],
+            },
+            "fleet_serial_refs": {
+                "seconds": round(
+                    max((c["serial_refs_s"] for c in children), default=0.0), 3
+                ),
+            },
+            "fleet_wall": {"seconds": round(wall_s, 3)},
+        },
+        "failures": failures,
+    }
+    name = "BENCH_fleet_smoke.json" if smoke_mode else f"BENCH_fleet_r{rnd:02d}.json"
+    out_path = os.environ.get(
+        "BENCH_FLEET_PATH", os.path.join(_artifacts_dir(), name)
+    )
+    try:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+    except OSError:
+        pass
+    print(json.dumps({k: v for k, v in doc.items() if k != "per_process"}, indent=1))
+    for f in failures:
+        print(f"fleet bench FAILED: {f}")
+    return 1 if failures else 0
+
+
 if __name__ == "__main__":
     if "--trend" in sys.argv:
         raise SystemExit(trend())
@@ -3155,6 +3643,13 @@ if __name__ == "__main__":
                 coldboot_bench_child(child, smoke_mode="--smoke" in sys.argv)
             )
         raise SystemExit(coldboot_bench(smoke_mode="--smoke" in sys.argv))
+    if "--fleet" in sys.argv:
+        child = os.environ.get("BENCH_FLEET_CHILD")
+        if child is not None and child != "":
+            raise SystemExit(
+                fleet_bench_child(int(child), smoke_mode="--smoke" in sys.argv)
+            )
+        raise SystemExit(fleet_bench(smoke_mode="--smoke" in sys.argv))
     if "--kernels" in sys.argv:
         raise SystemExit(kernels_bench(smoke_mode="--smoke" in sys.argv))
     if "--churn" in sys.argv:

@@ -54,6 +54,14 @@ ENV_COORDINATOR = "CITIZENS_DIST_COORDINATOR"
 ENV_NUM_PROCESSES = "CITIZENS_DIST_NUM_PROCESSES"
 ENV_PROCESS_ID = "CITIZENS_DIST_PROCESS_ID"
 
+#: environment contract for the graftfleet serving fleet: the fleet bench's
+#: parent exports these into every serving child so the router, the
+#: artifact-path scoping and the rollup all agree on the fleet shape without
+#: requiring a jax.distributed coordinator (serving processes are
+#: independent OS processes, each with its own virtual-device mesh).
+ENV_FLEET_PROCESSES = "CITIZENS_FLEET_PROCESSES"
+ENV_FLEET_INDEX = "CITIZENS_FLEET_INDEX"
+
 _LOCK = threading.Lock()
 _BOOTSTRAP: Optional["BootstrapInfo"] = None
 _DEFAULT_TOPOLOGY: Optional["Topology"] = None
@@ -231,6 +239,48 @@ def stamp_mesh_gauges(log, mesh: Mesh) -> None:
     log.gauge("dist_mesh_hosts", int(jax.process_count()))
     log.gauge("dist_mesh_devices", int(mesh.devices.size))
     log.gauge("dist_process_index", int(jax.process_index()))
+
+
+def fleet_process_count(cfg=None) -> int:
+    """How many serving processes the fleet runs.
+
+    Resolution order: ``Config.fleet_processes`` when > 0, else the
+    ``CITIZENS_FLEET_PROCESSES`` environment contract, else the jax process
+    count (1 on a laptop). The fleet contract is deliberately separate from
+    the jax.distributed triple above: serving processes are independent OS
+    processes routed by tenant affinity, not members of one SPMD program.
+    """
+    n = int(getattr(cfg, "fleet_processes", 0) or 0)
+    if n > 0:
+        return n
+    env = os.environ.get(ENV_FLEET_PROCESSES, "")
+    if env:
+        return max(int(env), 1)
+    return max(int(jax.process_count()), 1)
+
+
+def fleet_process_index() -> int:
+    """This process's fleet slot: ``CITIZENS_FLEET_INDEX`` when set (the
+    fleet bench's children), else the jax process index (0 on a laptop)."""
+    env = os.environ.get(ENV_FLEET_INDEX, "")
+    if env:
+        return max(int(env), 0)
+    return int(jax.process_index())
+
+
+def scoped_artifact_path(path: str) -> str:
+    """``artifacts/trace.json`` → ``artifacts/trace.p2.json`` on fleet
+    process 2 — the multi-process artifact contract. Every fleet child
+    writing evidence under a shared directory (traces, SLO/chaos reports,
+    metrics dumps) routes its path through here so concurrent processes
+    never clobber each other; single-process runs (index 0, fleet of 1)
+    return the path unchanged, keeping every existing artifact name stable.
+    """
+    idx = fleet_process_index()
+    if idx == 0 and fleet_process_count() <= 1:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.p{idx}{ext}"
 
 
 def reset_for_tests() -> None:

@@ -100,6 +100,12 @@ METRIC_SERIES: Dict[str, str] = {
     "graftserve_batcher_solves_per_dispatch": "member solves per cross-request dispatch",
     "graftserve_tenant_evictions": "session-LRU evictions, by owning tenant",
     "graftserve_slo_breach_total": "SLO objective breaches streamed to channels, by tenant and objective",
+    # --- graftfleet load management + fleet serving (service/fleet.py) ----
+    "graftserve_shed_total": "submissions shed by the SLO load-management policy, by tenant",
+    "graftserve_shed_active": "1 while the load policy is shedding admissions (gauge)",
+    "graftserve_shed_rearm_total": "load-policy recovery re-arms (cumulative gauge)",
+    "graftserve_degrade_rung": "current service-level degradation-ladder rung (gauge)",
+    "graftserve_shed_burn_worst": "worst fast-window SLO burn at the last policy update (gauge)",
     # --- graftdelta incremental re-certification (solvers/delta.py) ------
     "delta_cache_hit": "edits served by the sensitivity cache certificate (zero LP solves)",
     "delta_resume": "edits served by a warm ladder resume from a stored stage certificate",

@@ -208,3 +208,147 @@ class SloEngine:
             fresh = [current[k] for k in sorted(current) if k not in self._breached]
             self._breached = set(current)
         return fresh
+
+    def window_burns(self, window_s: float) -> Dict[Tuple[str, str], float]:
+        """Burn rate of every tenant × objective over the last ``window_s``
+        seconds only — the fast signal the load-management policy keys on.
+        An empty window (no events) yields an empty dict: burns age out with
+        their events, so a fully-shedding service can still observe recovery
+        without needing fresh terminal outcomes."""
+        now = self._clock()
+        with self._lock:
+            events = [e for e in self._events if e.t >= now - window_s]
+        out: Dict[Tuple[str, str], float] = {}
+        for tenant in sorted({e.tenant for e in events}):
+            tenant_events = [e for e in events if e.tenant == tenant]
+            for objective, target in sorted(self._objectives_for(tenant).items()):
+                _obs, burn = self._observe(tenant_events, objective, target)
+                out[(tenant, objective)] = round(burn, 4)
+        return out
+
+
+class SloLoadPolicy:
+    """graftfleet load management: the SLO engine closed into an actuator.
+
+    PR 15 made the engine *observe-only* — breaches stream as events and an
+    operator reacts. A fleet under open-loop load cannot wait for an
+    operator: offered rate does not slow down because the service is
+    drowning. This policy closes the loop with the two levers the stack
+    already certifies:
+
+    * **admission shedding** — while the fast-window burn rate of any
+      tenant × objective sits at/above ``serve_shed_burn``, new submissions
+      are rejected with a typed ``("error", {"kind": "ShedRejection", …})``
+      terminal event carrying an audit stub (tenant, burn, rung,
+      timestamp), counted ``graftserve_shed_total``. Shedding load is the
+      only move that helps a queue whose arrival rate exceeds service rate.
+    * **degradation-ladder descent** — each sustained breach interval walks
+      the service-level ladder one rung (megakernel→chained, device
+      pricing→host, ELL→dense by default: ``serve_shed_max_rungs=3`` stops
+      before the rungs that change the batching/mesh execution shape), so
+      surviving requests run the cheaper certified path. Rungs are applied
+      to the *service* config for every admitted request, independently of
+      the per-request retry ladder.
+
+    Recovery RE-ARMS: when every fast-window burn falls to/below
+    ``serve_shed_recover`` (hysteresis band below the shed threshold — or
+    the window empties entirely), shedding switches off, the ladder resets
+    to rung 0, and the transition is counted
+    ``graftserve_shed_rearm_total``. All state transitions happen inside
+    :meth:`update`, which both the submit path and the completion path
+    call, so recovery does not require fresh terminal outcomes.
+
+    Thread-safe; stdlib-only except a lazy import of the degradation ladder
+    table when a rung is actually applied.
+    """
+
+    def __init__(self, engine: SloEngine, cfg, clock=time.monotonic):
+        self.engine = engine
+        self.burn_open = float(getattr(cfg, "serve_shed_burn", 2.0))
+        self.burn_close = float(getattr(cfg, "serve_shed_recover", 0.5))
+        self.window_s = float(getattr(cfg, "serve_shed_window_s", 60.0))
+        self.max_rungs = int(getattr(cfg, "serve_shed_max_rungs", 3))
+        #: a sustained breach descends one further rung per cooldown, so a
+        #: single burst cannot slam the ladder to the bottom instantly
+        self.cooldown_s = max(self.window_s / 4.0, 1e-6)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.shedding = False
+        self.rung = 0
+        self.worst_burn = 0.0
+        self.shed_total = 0
+        self.rearm_total = 0
+        self.descend_total = 0
+        self._last_descent: Optional[float] = None
+
+    def update(self) -> float:
+        """Evaluate the fast window and run the state machine; returns the
+        worst observed burn. Called on every submit and every completion."""
+        burns = self.engine.window_burns(self.window_s)
+        worst = max(burns.values()) if burns else 0.0
+        now = self._clock()
+        with self._lock:
+            self.worst_burn = worst
+            if worst >= self.burn_open:
+                if not self.shedding:
+                    self.shedding = True
+                    self._descend(now)
+                elif (
+                    self._last_descent is not None
+                    and now - self._last_descent >= self.cooldown_s
+                ):
+                    self._descend(now)
+            elif worst <= self.burn_close and self.shedding:
+                self.shedding = False
+                self.rung = 0
+                self._last_descent = None
+                self.rearm_total += 1
+        return worst
+
+    def _descend(self, now: float) -> None:
+        if self.rung < self.max_rungs:
+            self.rung += 1
+            self.descend_total += 1
+        self._last_descent = now
+
+    def shed(self, tenant: str, request_id: str) -> Dict[str, Any]:
+        """Count one shed admission and return its audit stub — the typed
+        rejection ships evidence of WHY, not a bare refusal."""
+        with self._lock:
+            self.shed_total += 1
+            return {
+                "tenant": tenant,
+                "request_id": request_id,
+                "worst_burn": round(self.worst_burn, 4),
+                "burn_threshold": self.burn_open,
+                "rung": self.rung,
+                "window_s": self.window_s,
+                "t": self._clock(),
+            }
+
+    def degraded(self, cfg, log=None):
+        """``cfg`` with the policy's current rungs applied (cumulative, in
+        ladder order). Rung 0 returns ``cfg`` unchanged — the armed-but-idle
+        policy is bit-identical to no policy."""
+        with self._lock:
+            rung = self.rung
+        if rung <= 0:
+            return cfg
+        from citizensassemblies_tpu.robust.policy import DegradationLadder
+
+        ladder = DegradationLadder()
+        for _ in range(rung):
+            cfg = ladder.degrade(cfg, log)
+        return cfg
+
+    def stamp(self) -> Dict[str, Any]:
+        """Policy state snapshot for reports and the fleet rollup."""
+        with self._lock:
+            return {
+                "shedding": self.shedding,
+                "rung": self.rung,
+                "worst_burn": round(self.worst_burn, 4),
+                "shed_total": self.shed_total,
+                "rearm_total": self.rearm_total,
+                "descend_total": self.descend_total,
+            }

@@ -51,6 +51,7 @@ _KERNELS_RE = re.compile(r"BENCH_kernels_r(\d+)\.json$")
 _ROOFLINE_RE = re.compile(r"ROOFLINE_r(\d+)\.json$")
 _CHURN_RE = re.compile(r"BENCH_churn_r(\d+)\.json$")
 _COLDBOOT_RE = re.compile(r"BENCH_coldboot_r(\d+)\.json$")
+_FLEET_RE = re.compile(r"BENCH_fleet_r(\d+)\.json$")
 
 
 @dataclasses.dataclass
@@ -218,6 +219,14 @@ def collect_series(root) -> Tuple[Dict[str, List[Tuple[int, float]]], List[int]]
         # graftboot coldboot family (bench.py --coldboot): fresh-process
         # boot-to-first-certified-result wall clock, cached vs uncached
         m = _COLDBOOT_RE.search(path.name)
+        if m:
+            rows = _load_offline(path)
+            if rows:
+                by_round.setdefault(int(m.group(1)), {}).update(rows)
+    for path in sorted(root.glob("BENCH_fleet_r*.json")):
+        # graftfleet family (bench.py --fleet): open-loop fleet drive /
+        # serial-reference / whole-harness wall clocks, same detail schema
+        m = _FLEET_RE.search(path.name)
         if m:
             rows = _load_offline(path)
             if rows:

@@ -25,6 +25,16 @@ from citizensassemblies_tpu.service.context import (
     current_context,
     use_context,
 )
+from citizensassemblies_tpu.service.fleet import (
+    FleetProcess,
+    FleetRouter,
+    covering_tenants,
+    fleet_aggregate,
+    open_loop_schedule,
+    plan_from_config,
+    plan_open_loop,
+    rendezvous_route,
+)
 from citizensassemblies_tpu.service.server import (
     AdmissionError,
     RequestResult,
@@ -37,6 +47,8 @@ from citizensassemblies_tpu.service.session import TenantRegistry, TenantSession
 __all__ = [
     "AdmissionError",
     "CrossRequestBatcher",
+    "FleetProcess",
+    "FleetRouter",
     "RequestContext",
     "RequestResult",
     "ResultChannel",
@@ -44,6 +56,12 @@ __all__ = [
     "SelectionService",
     "TenantRegistry",
     "TenantSession",
+    "covering_tenants",
     "current_context",
+    "fleet_aggregate",
+    "open_loop_schedule",
+    "plan_from_config",
+    "plan_open_loop",
+    "rendezvous_route",
     "use_context",
 ]

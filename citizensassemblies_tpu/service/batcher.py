@@ -55,6 +55,16 @@ _FOLLOWER_TIMEOUT_S = 120.0
 #: detected within ~2 window widths instead of the 120 s safety net
 _WATCHDOG_POLL_S = 0.05
 
+#: graftfleet: one multi-device program in flight per process. Concurrent
+#: mesh-spanning dispatches from distinct batcher leaders can interleave
+#: their per-device launch order (dev0 runs program A's shard while dev1
+#: runs program B's), and the in-process collective rendezvous then waits
+#: on a partner that is queued behind the other program — a cross-program
+#: deadlock, observed under the fleet's open-loop drive on the forced
+#: multi-device host platform. Single-device dispatches never take this
+#: lock: they cannot participate in a launch-order cycle.
+_MESH_DISPATCH_LOCK = threading.Lock()
+
 
 class _Pending:
     """One request's deferred fleet, parked until the group dispatches."""
@@ -93,6 +103,11 @@ class CrossRequestBatcher:
             "max_requests_fused": 0,   # largest request count in one merge
             "leader_deaths": 0,        # leaders that died before dispatch
             "leader_reclaims": 0,      # follower re-elections after a death
+            # --- graftfleet mesh-spanning dispatch accounting --------------
+            "mesh_dispatches": 0,      # merged calls laid out over a mesh
+            "mesh_devices_max": 0,     # widest mesh a dispatch spanned
+            "dist_placements": 0,      # operands placed into their sharding
+            "dist_reshards": 0,        # PR 11 gauge: steady state must be 0
         }
 
     # --- public API ---------------------------------------------------------
@@ -266,10 +281,25 @@ class CrossRequestBatcher:
             mesh = dist_runtime.effective_mesh(cfg)
             if mesh is not None and len(merged) < int(mesh.devices.size):
                 mesh = None
-            sols = solve_lp_batch(
-                merged, cfg=cfg, log=None, warm_key=None,
-                max_iters=max_iters, defer=False, mesh=mesh,
-            )
+            # graftfleet: the engine counts its sharded-merge layout work
+            # (dist_placements / dist_reshards) into this dispatch-scoped
+            # log — harvested into the batcher stats below so the fleet
+            # rollup can hold the PR 11 zero-steady-state-reshard gauge
+            # at zero across every cross-request mesh dispatch
+            from citizensassemblies_tpu.utils.logging import RunLog
+
+            dispatch_log = RunLog(echo=False)
+            if mesh is not None:
+                with _MESH_DISPATCH_LOCK:
+                    sols = solve_lp_batch(
+                        merged, cfg=cfg, log=dispatch_log, warm_key=None,
+                        max_iters=max_iters, defer=False, mesh=mesh,
+                    )
+            else:
+                sols = solve_lp_batch(
+                    merged, cfg=cfg, log=dispatch_log, warm_key=None,
+                    max_iters=max_iters, defer=False, mesh=mesh,
+                )
             n_requests = len({
                 (p.ctx.tenant, p.ctx.request_id)
                 for p in batch if p.ctx is not None
@@ -281,6 +311,18 @@ class CrossRequestBatcher:
                     self._stats["fused_dispatches"] += 1
                 self._stats["max_requests_fused"] = max(
                     self._stats["max_requests_fused"], n_requests
+                )
+                if mesh is not None:
+                    self._stats["mesh_dispatches"] += 1
+                    self._stats["mesh_devices_max"] = max(
+                        self._stats["mesh_devices_max"],
+                        int(mesh.devices.size),
+                    )
+                self._stats["dist_placements"] += int(
+                    dispatch_log.counters.get("dist_placements", 0)
+                )
+                self._stats["dist_reshards"] += int(
+                    dispatch_log.counters.get("dist_reshards", 0)
                 )
             for pend, (start, end) in zip(batch, spans):
                 out = sols[start:end]

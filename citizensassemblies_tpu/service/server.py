@@ -241,6 +241,17 @@ class SelectionService:
             from citizensassemblies_tpu.obs.slo import SloEngine
 
             self.slo = SloEngine(slo_spec)
+        # --- graftfleet load management (obs/slo.py SloLoadPolicy) ---------
+        #: Config.serve_shed=True closes the SLO loop into an actuator:
+        #: sustained fast-window burn turns on admission shedding (typed
+        #: ShedRejection terminal events, counted graftserve_shed_total) and
+        #: walks the service-level degradation ladder; recovery re-arms.
+        #: Off (default) keeps the engine observe-only — pre-fleet behavior.
+        self.load_policy = None
+        if self.slo is not None and bool(getattr(self.cfg, "serve_shed", False)):
+            from citizensassemblies_tpu.obs.slo import SloLoadPolicy
+
+            self.load_policy = SloLoadPolicy(self.slo, self.cfg)
         # --- graftboot AOT executable cache (aot/) -------------------------
         #: the boot-loaded executable store. Tri-state Config.aot_cache:
         #: None loads a cache when one exists (missing → None, serve JIT),
@@ -259,6 +270,13 @@ class SelectionService:
 
     def submit(self, request: SelectionRequest) -> ResultChannel:
         """Admit one request; returns its streaming channel immediately."""
+        # load management first (shutdown still dominates below): the policy
+        # re-evaluates the fast window on EVERY submit, so a fully-shedding
+        # service recovers by event aging alone — no terminal outcomes needed
+        if self.load_policy is not None and not self._closed:
+            self.load_policy.update()
+            if self.load_policy.shedding:
+                return self._shed(request)
         with self._lock:
             if self._closed:
                 self.metrics.counter(
@@ -297,6 +315,36 @@ class SelectionService:
     def run(self, request: SelectionRequest, timeout: Optional[float] = None):
         """Convenience: submit and block for the result."""
         return self.submit(request).result(timeout=timeout)
+
+    def _shed(self, request: SelectionRequest) -> ResultChannel:
+        """Typed load-shed rejection: the channel terminates immediately
+        with ``("error", {"kind": "ShedRejection", "audit": …})`` — the
+        audit stub records WHY (burn, threshold, rung, window) so a shed is
+        evidence, not a bare refusal. Sheds never consume queue depth."""
+        rid = request.request_id or _next_request_id()
+        cfg = request.cfg or self.cfg
+        channel = ResultChannel(
+            rid, cap=int(getattr(cfg, "serve_channel_cap", 1024) or 1024)
+        )
+        stub = self.load_policy.shed(request.tenant, rid)
+        self.metrics.counter(
+            "graftserve_shed_total",
+            help="submissions shed by the SLO load-management policy",
+            labelnames=("tenant",),
+        ).labels(tenant=request.tenant).inc()
+        channel.push(
+            "error",
+            {
+                "kind": "ShedRejection",
+                "message": (
+                    f"request {rid} shed: fast-window SLO burn "
+                    f"{stub['worst_burn']:.2f} ≥ {stub['burn_threshold']:.2f}; "
+                    "retry after recovery"
+                ),
+                "audit": stub,
+            },
+        )
+        return channel
 
     def _maybe_prewarm(self, tenant: str, cfg: Config) -> None:
         """Speculative bucket pre-warm on a tenant's FIRST admission: touch
@@ -393,6 +441,26 @@ class SelectionService:
                 help="LRU evictions attributed per owner",
                 labelnames=("owner",),
             ).labels(owner=owner).set(n)
+        # graftfleet load-policy state (cumulative process gauges, same
+        # exposition shape as the graftboot counters below)
+        if self.load_policy is not None:
+            ps = self.load_policy.stamp()
+            m.gauge(
+                "graftserve_shed_active",
+                help="1 while the load policy is shedding admissions",
+            ).set(int(ps["shedding"]))
+            m.gauge(
+                "graftserve_degrade_rung",
+                help="current service-level degradation-ladder rung",
+            ).set(ps["rung"])
+            m.gauge(
+                "graftserve_shed_rearm_total",
+                help="load-policy recovery re-arms (cumulative)",
+            ).set(ps["rearm_total"])
+            m.gauge(
+                "graftserve_shed_burn_worst",
+                help="worst fast-window SLO burn at last policy update",
+            ).set(ps["worst_burn"])
         # graftboot store counters (cumulative process gauges): how much of
         # the fleet's dispatch is riding pre-compiled executables
         if self.aot_store is not None:
@@ -422,6 +490,8 @@ class SelectionService:
         snap["service"] = self.stats()
         if self.slo is not None:
             snap["slo"] = self.slo.evaluate()
+        if self.load_policy is not None:
+            snap["load_policy"] = self.load_policy.stamp()
         snap["ts"] = time.time()
         return snap
 
@@ -502,6 +572,8 @@ class SelectionService:
         if self.slo is None:
             return
         self.slo.record(tenant, latency_s, ok)
+        if self.load_policy is not None:
+            self.load_policy.update()
         breaches = self.slo.new_breaches()
         if not breaches:
             return
@@ -545,6 +617,12 @@ class SelectionService:
             t_submit = t0
         base_cfg = request.cfg or self.cfg
         log = _ChannelLog(channel)
+        # graftfleet: an armed load policy runs admitted requests under its
+        # CURRENT ladder rungs (rung 0 ≡ unchanged — bit-identical when the
+        # policy is idle); the per-request retry ladder below then degrades
+        # further from that base on transient faults
+        if self.load_policy is not None:
+            base_cfg = self.load_policy.degraded(base_cfg, log)
         # --- graftfault per-request machinery (robust/) --------------------
         injector = None
         if getattr(base_cfg, "fault_sites", ""):

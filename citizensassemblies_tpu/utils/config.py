@@ -406,6 +406,39 @@ class Config:
     #: always retained, so a long-running request's stream cannot grow
     #: without bound.
     serve_channel_cap: int = 1024
+    #: graftfleet SLO-driven load management (``obs/slo.py``
+    #: ``SloLoadPolicy``): ``True`` arms the policy on a service whose SLO
+    #: engine is configured (``obs_slo_spec`` non-empty) — sustained
+    #: fast-window burn-rate breaches turn on admission SHEDDING (each shed
+    #: submit gets a typed ``ShedRejection`` terminal event with an audit
+    #: stub, counted ``graftserve_shed_total``) and walk the service-level
+    #: degradation ladder one rung at a time (megakernel→chained, device
+    #: pricing→host, ELL→dense); recovery re-arms (shedding off, ladder
+    #: reset, counted ``graftserve_shed_rearm_total``). ``False`` (the
+    #: default) keeps the SLO engine observe-only — pre-fleet behavior,
+    #: bit-identical.
+    serve_shed: bool = False
+    #: fast-window burn rate at or above which the load policy opens
+    #: (sheds + descends): burn 1.0 = consuming error budget exactly at
+    #: the sustainable rate, so the default trips at 2× sustainable.
+    serve_shed_burn: float = 2.0
+    #: fast-window burn rate at or below which every objective must sit
+    #: for the policy to RE-ARM (shedding off, ladder reset) — the
+    #: hysteresis band between this and ``serve_shed_burn`` prevents
+    #: flapping.
+    serve_shed_recover: float = 0.5
+    #: the load policy's fast evaluation window (seconds): burn rates are
+    #: computed over the most recent window this long, so overload is
+    #: detected (and recovery observed) at this granularity rather than
+    #: the SLO engine's slower alerting windows.
+    serve_shed_window_s: float = 60.0
+    #: deepest degradation-ladder rung the LOAD policy may walk (the fault
+    #: path's per-request ladder is not capped by this). The default stops
+    #: after the three capacity rungs (megakernel→chained, device
+    #: pricing→host MILP, ELL→dense) — load management trades peak
+    #: throughput for stability but never silently leaves the mesh or the
+    #: batched engine.
+    serve_shed_max_rungs: int = 3
     #: graftdelta incremental re-certification, tri-state. ``False`` = hard
     #: off: ``revise`` requests run the plain from-scratch solver and never
     #: touch the session delta store — bit-identical to pre-delta builds
@@ -503,6 +536,23 @@ class Config:
     #: --dist``). ``False`` falls back to the per-call ad-hoc layout the
     #: engine used before graftpod (kept as a diagnostic escape hatch).
     dist_prepartition: bool = True
+    #: graftfleet serving-fleet size: how many serving processes the fleet
+    #: router spreads tenants over (rendezvous hashing — every front end
+    #: routes identically with no coordination). 0 (the default) reads the
+    #: ``CITIZENS_FLEET_PROCESSES`` environment contract and falls back to
+    #: the jax process count, so a pod launch needs no config edit.
+    fleet_processes: int = 0
+    #: offered request rate (requests/second, WHOLE fleet) of the open-loop
+    #: load harness: arrivals follow a seeded Poisson process at this rate
+    #: and are submitted on schedule regardless of completions — the
+    #: open-loop discipline under which "sustained req/s at fixed p50/p99
+    #: sojourn" is meaningful (a closed loop self-throttles and hides
+    #: queueing collapse).
+    fleet_offered_rate_hz: float = 250.0
+    #: distinct tenants of the synthetic fleet workload; the rendezvous
+    #: router maps each to its owning process, so warm slots, session
+    #: EllPacks, memos and delta stores stay process-local.
+    fleet_tenants: int = 8
     #: graftspmd (``lint/spmd.py``) implicit-replication threshold, bytes: a
     #: registered core argument with NO declared ``dist/partition.py`` role
     #: larger than this is flagged at mesh sizes > 1 — an implicitly
